@@ -1,0 +1,113 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: linsheng011/Paddle, surveyed in
+/root/repo/SURVEY.md). Eager define-by-run tensors over jax.Array/PJRT,
+whole-function jit (the to_static analog), and mesh-based hybrid
+parallelism over ICI/DCN. Top-level namespace mirrors `paddle.*`
+(python/paddle/__init__.py of the reference).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core import (
+    Parameter,
+    Tensor,
+    enable_grad,
+    get_default_dtype,
+    get_device,
+    grad,
+    no_grad,
+    seed,
+    set_default_dtype,
+    set_device,
+)
+from paddle_tpu.core.random import get_rng_state, set_rng_state
+from paddle_tpu import ops
+from paddle_tpu.ops.creation import (
+    arange,
+    diag,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    meshgrid,
+    one_hot,
+    ones,
+    ones_like,
+    to_tensor,
+    tril,
+    triu,
+    zeros,
+    zeros_like,
+)
+from paddle_tpu.ops.math import (
+    abs, add, atan2, cast, ceil, clip, cos, cosh, divide, equal, erf, exp,
+    floor, floor_divide, greater_equal, greater_than, increment, isfinite,
+    isinf, isnan, less_equal, less_than, lerp, log, log1p, log2, log10,
+    logical_and, logical_not, logical_or, logical_xor, maximum, minimum, mod,
+    multiply, multiplex, nan_to_num, neg, not_equal, pow, reciprocal, round,
+    rsqrt, scale, sign, sin, sinh, sqrt, square, subtract, tan, tanh, trunc,
+    where, addmm,
+)
+from paddle_tpu.ops.manipulation import (
+    broadcast_to, chunk, clone, concat, crop, expand, expand_as, flatten,
+    flip, gather, gather_nd, index_select, masked_select, moveaxis, numel,
+    put_along_axis, repeat_interleave, reshape, roll, rot90, scatter, slice,
+    split, squeeze, stack, strided_slice, take_along_axis, tile, transpose,
+    unbind, unsqueeze, unstack,
+)
+from paddle_tpu.ops.reduction import (
+    all, amax, amin, any, argmax, argmin, argsort, bincount, count_nonzero,
+    cumprod, cumsum, kthvalue, logsumexp, max, mean, median, min, mode,
+    nanmean, nansum, nonzero, prod, quantile, sort, std, sum, topk, unique,
+    var,
+)
+from paddle_tpu.ops.linalg import (
+    bmm, cross, det, diagonal, dist, dot, eigh, histogram, inner, inverse,
+    kron, matmul, mm, mv, norm, outer, pinv, qr, slogdet, solve, svd, t,
+    trace,
+)
+from paddle_tpu.ops.random_ops import (
+    bernoulli, multinomial, normal, poisson, rand, randint, randint_like,
+    randn, randperm, shuffle, standard_normal, uniform,
+)
+
+from paddle_tpu import amp  # noqa: E402
+from paddle_tpu import nn  # noqa: E402
+from paddle_tpu import optimizer  # noqa: E402
+from paddle_tpu import io  # noqa: E402
+from paddle_tpu import jit  # noqa: E402
+from paddle_tpu import distributed  # noqa: E402
+from paddle_tpu.framework.io import load, save  # noqa: E402
+from paddle_tpu import device  # noqa: E402
+from paddle_tpu import vision  # noqa: E402
+from paddle_tpu import metric  # noqa: E402
+from paddle_tpu import profiler  # noqa: E402
+
+# paddle-style helpers
+def is_grad_enabled():
+    from paddle_tpu.core.autograd import is_grad_enabled as _f
+
+    return _f()
+
+
+def in_dynamic_mode():
+    return True
+
+
+disable_static = lambda: None
+enable_static = lambda: None
+
+bfloat16 = "bfloat16"
+float16 = "float16"
+float32 = "float32"
+float64 = "float64"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool = "bool"
+complex64 = "complex64"

@@ -1,0 +1,80 @@
+"""AMP autocast — analog of python/paddle/amp/auto_cast.py (white/black
+lists at amp/auto_cast.py:76-93) and the eager insertion point
+eager_amp_auto_cast.h.
+
+TPU-first policy: bf16 is the default low precision (no loss scaling
+needed); fp16 kept only for API parity. Casting happens at op dispatch
+(ops/dispatch.py) and compiles into the surrounding XLA computation under
+jit — zero eager overhead when disabled.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu.core import dtype as dtypes
+
+# ops that benefit from low precision (MXU ops) — the white list
+WHITE_LIST = {
+    "matmul", "linear", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
+    "mm", "bmm", "einsum", "sdpa",
+}
+
+# numerically sensitive ops that must stay fp32 — the black list
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "softmax_ce", "softmax_ce_soft", "cross_entropy",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "mse_loss", "l1_loss", "bce_loss", "bce_logits", "kl_div", "sum", "mean",
+    "norm", "logsumexp", "cumsum",
+}
+
+_state = {"enabled": False, "dtype": "bfloat16", "level": "O1"}
+
+
+def amp_state():
+    return _state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast analog."""
+    prev = dict(_state)
+    prev_extra = (_state.get("extra_white"), _state.get("extra_black"))
+    _state.update(
+        enabled=bool(enable),
+        dtype=dtypes.canonical_name(dtype),
+        level=level,
+        extra_white=frozenset(custom_white_list or ()),
+        extra_black=frozenset(custom_black_list or ()),
+    )
+    try:
+        yield
+    finally:
+        _state.clear()
+        _state.update(prev)
+        if prev_extra[0] is not None:
+            _state["extra_white"], _state["extra_black"] = prev_extra
+
+
+def maybe_autocast(op_name, inputs, policy=None):
+    """Called from ops.dispatch.apply before running an op. Casts floating
+    inputs to the amp dtype for white-list ops, to fp32 for black-list ops
+    (O1); casts everything low-precision except blacklist in O2."""
+    if not _state["enabled"] or op_name == "amp_cast":
+        return inputs
+    import jax.numpy as jnp  # noqa: F401
+
+    white = WHITE_LIST | _state.get("extra_white", frozenset())
+    black = BLACK_LIST | _state.get("extra_black", frozenset())
+    low = dtypes.to_jax(_state["dtype"])
+    level = _state["level"]
+
+    # Tracked casts (ops, not raw astype) keep autograd correct.
+    from .cast_helper import cast_tensor_list
+
+    if op_name in black:
+        return cast_tensor_list(inputs, jnp.float32)
+    if op_name in white or level == "O2":
+        return cast_tensor_list(inputs, low)
+    return inputs

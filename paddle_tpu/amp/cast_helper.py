@@ -1,0 +1,24 @@
+"""Autograd-correct dtype casts used by AMP autocast."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cast_tensor_list(inputs, to_dtype):
+    """Cast floating Tensors to to_dtype via a tracked op so gradients
+    flow back in the original dtype (the cast's VJP casts the cotangent
+    back — exactly what jax.vjp of astype gives us)."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.ops.dispatch import apply
+
+    out = []
+    for t in inputs:
+        if (
+            isinstance(t, Tensor)
+            and jnp.issubdtype(t._array.dtype, jnp.floating)
+            and t._array.dtype != to_dtype
+        ):
+            out.append(apply("amp_cast", lambda a: a.astype(to_dtype), t))
+        else:
+            out.append(t)
+    return out

@@ -1,0 +1,234 @@
+"""Define-by-run autograd engine.
+
+TPU-native analog of the reference's eager autograd
+(paddle/fluid/eager/backward.cc:105 RunBackward,
+paddle/fluid/eager/grad_node_info.h:168 GradNodeBase): every differentiable
+op records a `Node` holding a jax VJP closure; `backward()` walks nodes in
+reverse creation order (a tape — creation order IS a topological order for
+define-by-run graphs) and accumulates cotangents. Leaf accumulation is the
+analog of GradNodeAccumulation (eager/accumulation/accumulation_node.h).
+
+Because the VJP closures hold jax arrays (residuals) and call jax ops, the
+whole engine works identically on concrete device arrays (eager mode) and
+on tracers (inside `paddle_tpu.jit.to_static` — where the entire
+forward+backward collapses into one XLA computation).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+_grad_enabled = True
+_node_counter = 0
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Analog of paddle.no_grad (dygraph tracer has_grad=False)."""
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = True
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+class Node:
+    """One recorded op on the tape; analog of a generated GradNode.
+
+    Attributes:
+      vjp_fn: closure from jax.vjp — maps output cotangents to input
+        cotangents. Holds forward residuals (the TensorWrapper analog,
+        eager/tensor_wrapper.h).
+      inputs: the input Tensors (only those participating in autodiff).
+      out_specs: (shape, dtype) per output, for synthesizing zero
+        cotangents for outputs never used downstream.
+    """
+
+    __slots__ = (
+        "name",
+        "seq",
+        "vjp_fn",
+        "inputs",
+        "out_specs",
+        "out_cts",
+        "hooks",
+        "out_hooks",
+    )
+
+    def __init__(self, name: str, vjp_fn: Callable, inputs: Sequence, out_specs: List):
+        global _node_counter
+        _node_counter += 1
+        self.name = name
+        self.seq = _node_counter
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)
+        self.out_specs = out_specs
+        self.out_cts: List[Optional[object]] = [None] * len(out_specs)
+        self.hooks: List[Callable] = []
+        self.out_hooks: dict = {}
+
+    def accumulate_out_ct(self, idx: int, ct):
+        cur = self.out_cts[idx]
+        self.out_cts[idx] = ct if cur is None else cur + ct
+
+    def materialized_cts(self):
+        cts = []
+        for i, (ct, (shape, dtype)) in enumerate(zip(self.out_cts, self.out_specs)):
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            for hook in self.out_hooks.get(i, ()):
+                out = hook(ct)
+                if out is not None:
+                    ct = out
+            cts.append(ct)
+        return tuple(cts) if len(cts) != 1 else cts[0]
+
+    def __repr__(self):
+        return f"<Node {self.name} seq={self.seq}>"
+
+
+def _collect_graph(root_node: Node):
+    """DFS from the root collecting reachable nodes."""
+    seen = {}
+    stack = [root_node]
+    while stack:
+        n = stack.pop()
+        if n.seq in seen:
+            continue
+        seen[n.seq] = n
+        for t in n.inputs:
+            creator = t._creator
+            if creator is not None and creator.seq not in seen:
+                stack.append(creator)
+    return seen
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
+                 sink: dict = None):
+    """Analog of egr::RunBackward (paddle/fluid/eager/backward.cc:105).
+
+    Seeds cotangents on `tensors`, processes reachable nodes in reverse
+    creation order, accumulates `.grad` on leaf tensors with
+    stop_gradient=False. If `sink` is given (paddle.grad path), leaf
+    cotangents accumulate into sink[id(tensor)] instead of `.grad` — no
+    tensor state is mutated.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    def leaf_accumulate(t, ct):
+        if sink is not None:
+            key = id(t)
+            sink[key] = ct if key not in sink else sink[key] + ct
+        else:
+            t._accumulate_grad(ct)
+
+    roots = []
+    with no_grad():
+        for t, g in zip(tensors, grad_tensors):
+            if g is None:
+                seed_ct = jnp.ones(t._array.shape, t._array.dtype)
+            else:
+                seed_ct = g._array if isinstance(g, Tensor) else jnp.asarray(g)
+            if t._creator is not None:
+                t._creator.accumulate_out_ct(t._out_idx, seed_ct)
+                roots.append(t._creator)
+            elif not t.stop_gradient:
+                leaf_accumulate(t, seed_ct)
+
+        if not roots:
+            return
+
+        nodes = {}
+        for r in roots:
+            nodes.update(_collect_graph(r))
+
+        for seq in sorted(nodes.keys(), reverse=True):
+            node = nodes[seq]
+            if all(ct is None for ct in node.out_cts):
+                continue  # branch never contributed to the loss
+            cts = node.materialized_cts()
+            in_cts = node.vjp_fn(cts)
+            for hook in node.hooks:
+                in_cts = hook(in_cts) or in_cts
+            for t, ct in zip(node.inputs, in_cts):
+                if ct is None:
+                    continue
+                # jax uses float0 for nondifferentiable (integer) inputs
+                if getattr(ct, "dtype", None) is not None and ct.dtype.name == "float0":
+                    continue
+                if t._creator is not None:
+                    t._creator.accumulate_out_ct(t._out_idx, ct)
+                elif not t.stop_gradient:
+                    leaf_accumulate(t, ct)
+            if not retain_graph:
+                node.vjp_fn = None
+                node.out_cts = [None] * len(node.out_specs)
+            else:
+                node.out_cts = [None] * len(node.out_specs)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         allow_unused=False):
+    """Analog of paddle.grad (GeneralGrad, eager/general_grad.h): returns
+    grads of `outputs` w.r.t. `inputs` without touching `.grad` slots.
+
+    Implemented by temporarily re-pointing leaf accumulation into a side
+    table. create_graph (double grad) is supported because the engine runs
+    on tracers just as well as on concrete arrays — callers wanting higher
+    order grads should use the functional `paddle_tpu.jit` APIs instead.
+    """
+    from .tensor import Tensor
+
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+
+    saved = [t.stop_gradient for t in inputs]
+    for t in inputs:
+        t.stop_gradient = False
+    sink: dict = {}
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
+                     sink=sink)
+        results = []
+        for t in inputs:
+            ct = sink.get(id(t))
+            if ct is None and not allow_unused:
+                ct = jnp.zeros(t._array.shape, t._array.dtype)
+            results.append(Tensor._wrap(ct) if ct is not None else None)
+        return results
+    finally:
+        for t, sg in zip(inputs, saved):
+            t.stop_gradient = sg

@@ -1,0 +1,86 @@
+"""Device API — analog of python/paddle/device/__init__.py:355 (set_device).
+
+On TPU there is exactly one native accelerator; "places" map onto jax
+devices. `set_device('tpu')`/`set_device('cpu')` select the default jax
+device used for newly created tensors. Unlike the reference's
+DeviceContextPool (paddle/fluid/platform/device_context.h:353), there is
+no per-stream context to manage: XLA/PJRT owns streams and ordering.
+"""
+from __future__ import annotations
+
+import jax
+
+_current_place = None
+
+
+class Place:
+    """A device place, e.g. Place('tpu', 0). Analog of phi::Place."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _platform_of(d) == self.device_type]
+        if not devs:
+            devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+def _platform_of(d) -> str:
+    p = d.platform
+    return "tpu" if p in ("tpu", "axon") else p
+
+
+def _parse(device: str) -> Place:
+    device = device.lower()
+    if ":" in device:
+        kind, idx = device.split(":", 1)
+        return Place(kind, int(idx))
+    return Place(device, 0)
+
+
+def set_device(device: str) -> Place:
+    """Select the default device; analog of paddle.device.set_device
+    (python/paddle/device/__init__.py:355)."""
+    global _current_place
+    place = _parse(device)
+    # validate it exists; fall back to whatever jax default is
+    place.jax_device()
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    p = get_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        d = jax.devices()[0]
+        _current_place = Place(_platform_of(d), 0)
+    return _current_place
+
+
+def default_jax_device():
+    return get_place().jax_device()
+
+
+def is_compiled_with_cuda() -> bool:  # API parity; this build has zero CUDA
+    return False
+
+
+def device_count() -> int:
+    return len(jax.devices())

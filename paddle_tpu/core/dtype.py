@@ -1,0 +1,120 @@
+"""Dtype registry and promotion helpers.
+
+TPU-native analog of the reference's phi dtype system
+(paddle/phi/common/data_type.h). We standardise on strings that map onto
+jax.numpy dtypes; bfloat16 is first-class (it is the TPU MXU's native
+matmul dtype), fp16 exists only for API parity.
+
+Deliberate TPU-first deviation from the reference: 64-bit numeric types
+are ALIASES for their 32-bit counterparts ("int64"->int32,
+"float64"->float32). TPUs have no native f64 and emulate s64; XLA's
+index type is s32. The API accepts the 64-bit names everywhere (paddle
+parity — e.g. int64 labels) but storage and compute are 32-bit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_DTYPE_MAP = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,  # unreachable: aliased to int32
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float32",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "int": "int32",
+    "long": "int32",
+    # 64-bit -> 32-bit (TPU-native; see module docstring)
+    "int64": "int32",
+    "uint64": "uint32",
+    "float64": "float32",
+    "complex128": "complex64",
+}
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    name = canonical_name(d)
+    if name not in ("float32", "float64", "float16", "bfloat16"):
+        raise ValueError(f"default dtype must be floating, got {name}")
+    _default_dtype = name
+
+
+def get_default_dtype() -> str:
+    return _default_dtype
+
+
+def canonical_name(d) -> str:
+    """Normalise any dtype-ish object to a canonical string name."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        d = _ALIASES.get(d, d)
+        if d in _DTYPE_MAP:
+            return d
+        # fall through to numpy parsing for things like 'f4'
+    try:
+        name = jnp.dtype(d).name
+    except TypeError as e:  # pragma: no cover
+        raise TypeError(f"unsupported dtype: {d!r}") from e
+    name = _ALIASES.get(name, name)
+    if name not in _DTYPE_MAP:
+        raise TypeError(f"unsupported dtype: {d!r}")
+    return name
+
+
+def to_jax(d):
+    """Any dtype-ish -> jnp dtype object."""
+    return jnp.dtype(_DTYPE_MAP[canonical_name(d)])
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(to_jax(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(to_jax(d), jnp.integer)
+
+
+def is_inexact(d) -> bool:
+    return jnp.issubdtype(to_jax(d), jnp.inexact)
+
+
+def infer_dtype(value):
+    """Dtype for a host value the way the reference's to_tensor does:
+    python float -> default float dtype, python int -> int64, bool -> bool.
+    """
+    if isinstance(value, bool):
+        return "bool"
+    if isinstance(value, int):
+        return "int32"
+    if isinstance(value, float):
+        return _default_dtype
+    if isinstance(value, complex):
+        return "complex64"
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        # match paddle.to_tensor: host doubles become default float dtype
+        return _default_dtype
+    return canonical_name(arr.dtype)

@@ -1,0 +1,74 @@
+"""RNG state — analog of phi::Generator (paddle/phi/core/generator.h:23).
+
+The reference keeps per-device stateful Philox generators. The TPU-native
+design is a functional JAX PRNG key chain: a global Generator holds one
+key and splits a fresh subkey per random op. Parallel determinism across
+mesh axes is handled by RNGStatesTracker (distributed/random.py), the
+analog of fleet/layers/mpu/random.py:35.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful wrapper over a jax PRNG key chain."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+            self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Split and return a fresh subkey (thread-safe)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._count += 1
+            return sub
+
+    def get_state(self):
+        with self._lock:
+            return (self._seed, self._count, jax.random.key_data(self._key))
+
+    def set_state(self, state):
+        seed, count, key_data = state
+        with self._lock:
+            self._seed = seed
+            self._count = count
+            self._key = jax.random.wrap_key_data(np.asarray(key_data))
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """Analog of paddle.seed: reseeds the global generator."""
+    return _default_generator.manual_seed(value)
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

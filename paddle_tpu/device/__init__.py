@@ -1,0 +1,35 @@
+"""paddle.device analog (python/paddle/device/__init__.py)."""
+from paddle_tpu.core.device import (
+    Place,
+    default_jax_device,
+    device_count,
+    get_device,
+    get_place,
+    is_compiled_with_cuda,
+    set_device,
+)
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+
+    try:
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except Exception:
+        return False
+
+
+def synchronize():
+    """Block until all pending device work completes — analog of
+    device.cuda.synchronize; PJRT equivalent is draining async dispatch."""
+    import jax
+
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+cuda = None  # no CUDA in this build (paddle.device.cuda parity stub)
+
+__all__ = [
+    "set_device", "get_device", "get_place", "device_count", "Place",
+    "is_compiled_with_cuda", "is_compiled_with_tpu", "synchronize",
+]

@@ -1,0 +1,56 @@
+"""paddle.distributed analog — mesh-native parallelism (SURVEY §2.5).
+
+Design: one jax.sharding.Mesh with named axes ('pp','dp','sharding','ep',
+'cp','mp') replaces the reference's per-dimension NCCL process groups;
+collectives compile into the training step (XLA over ICI/DCN); the
+paddle-parity eager API is kept as a thin façade.
+"""
+from jax.sharding import PartitionSpec
+
+from . import functional
+from .collective import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import (
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .sharding_api import (
+    ProcessMesh,
+    get_mesh,
+    shard_tensor,
+    with_sharding_constraint,
+)
+from .topology import (
+    AXIS_ORDER,
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "all_reduce", "all_gather", "broadcast", "reduce", "scatter", "alltoall",
+    "reduce_scatter", "send", "recv", "barrier", "new_group", "get_group",
+    "ReduceOp", "Group", "functional", "CommunicateTopology",
+    "HybridCommunicateGroup", "get_hybrid_communicate_group",
+    "set_hybrid_communicate_group", "ProcessMesh", "shard_tensor",
+    "with_sharding_constraint", "get_mesh", "PartitionSpec", "AXIS_ORDER",
+]

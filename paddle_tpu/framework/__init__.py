@@ -1,0 +1,3 @@
+from .io import load, save
+
+__all__ = ["save", "load"]

@@ -1,0 +1,58 @@
+"""paddle.save / paddle.load analog (python/paddle/framework/io.py:637/:879).
+
+Pickle-compatible nested state dicts; Tensors serialize as numpy arrays
+(the DenseTensor-proto analog of phi/core/serialization.cc). bfloat16
+round-trips via a tagged uint16 view (numpy has no native bf16).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+_BF16_TAG = "__paddle_tpu_bf16__"
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj._array)
+        if arr.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True, "data": arr.view(np.uint16)}
+        return arr
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    return obj
+
+
+def _from_host(obj):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            return Tensor(obj["data"].view(jnp.bfloat16))
+        return {k: _from_host(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_host(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if configs.get("return_numpy"):
+        return obj
+    return _from_host(obj)

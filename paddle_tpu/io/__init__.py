@@ -1,0 +1,25 @@
+from .dataloader import DataLoader
+from .dataset import (
+    ChainDataset,
+    ComposeDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+)
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "Subset", "random_split", "DataLoader", "BatchSampler",
+    "DistributedBatchSampler", "Sampler", "RandomSampler", "SequenceSampler",
+    "WeightedRandomSampler",
+]

@@ -1,0 +1,167 @@
+"""DataLoader — analog of python/paddle/fluid/reader.py:311 (DataLoader)
+and fluid/dataloader/ (worker.py, collate.py).
+
+TPU-native design: the loader produces pinned host numpy batches and
+hands jax the device transfer (jax.device_put is async; XLA overlaps the
+h2d copy with compute). Multiprocess workers use the standard
+multiprocessing pool with numpy shared transport — the analog of the
+reference's shared-memory tensor transport (dataloader/worker.py) without
+the custom blocking-queue C++ layer (operators/reader/) which PJRT makes
+unnecessary.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Analog of fluid/dataloader/collate.py default_collate_fn."""
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return Tensor(np.stack([np.asarray(s._array) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn(list(items)) for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn):
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        i, indices = item
+        try:
+            batch = [dataset[j] for j in indices]
+            data = collate_fn(batch)
+            data = _to_numpy(data)
+            data_queue.put((i, data))
+        except Exception as e:  # pragma: no cover
+            data_queue.put((i, e))
+
+
+def _to_numpy(data):
+    if isinstance(data, Tensor):
+        return np.asarray(data._array)
+    if isinstance(data, tuple):
+        return tuple(_to_numpy(d) for d in data)
+    if isinstance(data, dict):
+        return {k: _to_numpy(v) for k, v in data.items()}
+    return data
+
+
+def _to_tensor(data):
+    if isinstance(data, np.ndarray):
+        return Tensor(data)
+    if isinstance(data, tuple):
+        return tuple(_to_tensor(d) for d in data)
+    if isinstance(data, dict):
+        return {k: _to_tensor(v) for k, v in data.items()}
+    return data
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False,
+                 drop_last=False, collate_fn=None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True,
+                 timeout=0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_size = batch_size
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multiprocess()
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch:
+            yield self.collate_fn(batch)
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            batch = [self.dataset[i] for i in indices]
+            yield self.collate_fn(batch)
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_queue = ctx.Queue()
+        data_queue = ctx.Queue()
+        workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queue, data_queue, self.collate_fn),
+                daemon=True,
+            )
+            for _ in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            batches = list(self.batch_sampler)
+            inflight = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            next_submit = 0
+            buffered = {}
+            next_yield = 0
+            while next_yield < len(batches):
+                while next_submit < len(batches) and inflight < max_inflight:
+                    index_queue.put((next_submit, batches[next_submit]))
+                    next_submit += 1
+                    inflight += 1
+                while next_yield not in buffered:
+                    i, data = data_queue.get()
+                    if isinstance(data, Exception):
+                        raise data
+                    buffered[i] = data
+                    inflight -= 1
+                data = buffered.pop(next_yield)
+                next_yield += 1
+                yield _to_tensor(data)
+        finally:
+            for _ in workers:
+                index_queue.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

@@ -1,0 +1,4 @@
+from .api import TrainStep, not_to_static, to_static
+from .save_load import load, save
+
+__all__ = ["to_static", "not_to_static", "TrainStep", "save", "load"]

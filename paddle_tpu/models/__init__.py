@@ -1,0 +1,3 @@
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]

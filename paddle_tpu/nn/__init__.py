@@ -1,0 +1,35 @@
+"""paddle.nn analog (python/paddle/nn/, 35.9k LoC in the reference)."""
+from . import functional, initializer
+from .activation_layers import (
+    CELU, ELU, GELU, SELU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+    Sigmoid, Silu, Softmax, Softplus, Softshrink, Softsign, Swish, Tanh,
+    Tanhshrink,
+)
+from .common import (
+    CosineSimilarity, Dropout, Dropout2D, Embedding, Flatten, Identity,
+    Linear, Pad2D, PixelShuffle, Upsample,
+)
+from .container import LayerDict, LayerList, ParameterList, Sequential
+from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+from .layer import Layer, ParamAttr
+from .loss import (
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss,
+    KLDivLoss, L1Loss, MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
+)
+from .norm import (
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+    InstanceNorm2D, LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
+)
+from .pooling import (
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, MaxPool1D,
+    MaxPool2D,
+)
+from .transformer import (
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+
+F = functional
+
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: E402

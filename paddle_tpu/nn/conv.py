@@ -1,0 +1,111 @@
+"""Conv layers — analog of python/paddle/nn/layer/conv.py. Weight layout
+OIHW (paddle); convs lower to lax.conv_general_dilated on the MXU."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.ops import nn_ops
+
+from .layer import Layer
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nsp,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _pair(kernel_size, nsp)
+        self._stride = _pair(stride, nsp)
+        self._padding = padding
+        self._dilation = _pair(dilation, nsp)
+        self._groups = groups
+        self._data_format = data_format
+        fan_in = in_channels // groups * int(np.prod(self._kernel_size))
+        w_shape = [out_channels, in_channels // groups] + list(self._kernel_size)
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return nn_ops.conv2d(x, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._groups,
+                             self._data_format)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return nn_ops.conv1d(x, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._groups)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return nn_ops.conv3d(x, self.weight, self.bias, self._stride,
+                             self._padding, self._dilation, self._groups)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._stride = _pair(stride)
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = _pair(dilation)
+        self._groups = groups
+        ks = _pair(kernel_size)
+        fan_in = in_channels * int(np.prod(ks))
+        # paddle transpose-conv weight layout: [in, out//groups, kh, kw]
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + list(ks),
+            attr=weight_attr, default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.conv2d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._dilation, self._groups)

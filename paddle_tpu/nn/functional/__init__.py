@@ -1,0 +1,25 @@
+"""paddle.nn.functional analog — re-exports the functional op surface
+(python/paddle/nn/functional/)."""
+from paddle_tpu.ops.activation import (
+    celu, elu, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid, hardswish,
+    hardtanh, leaky_relu, log_sigmoid, log_softmax, maxout, mish, prelu, relu,
+    relu6, selu, sigmoid, silu, softmax, softplus, softshrink, softsign,
+    swish, tanh, tanhshrink,
+)
+from paddle_tpu.ops.creation import one_hot
+from paddle_tpu.ops.manipulation import pad
+from paddle_tpu.ops.nn_ops import (
+    adaptive_avg_pool2d, adaptive_max_pool2d, affine_grid, avg_pool1d,
+    avg_pool2d, batch_norm, bce_loss, bce_with_logits, conv1d, conv2d,
+    conv2d_transpose, conv3d, cosine_similarity, cross_entropy, dropout,
+    dropout2d, embedding, fused_bias_dropout_residual_layer_norm, grid_sample,
+    group_norm, hinge_embedding_loss, instance_norm, interpolate, kl_div,
+    l1_loss, label_smooth, layer_norm, linear, margin_ranking_loss,
+    max_pool1d, max_pool2d, mse_loss, nll_loss, pixel_shuffle, rms_norm,
+    scaled_dot_product_attention, smooth_l1_loss, softmax_with_cross_entropy,
+    temporal_shift, unfold,
+)
+
+binary_cross_entropy = bce_loss
+binary_cross_entropy_with_logits = bce_with_logits
+upsample = interpolate

@@ -1,0 +1,313 @@
+"""Layer base class — analog of python/paddle/fluid/dygraph/layers.py
+(paddle.nn.Layer): parameter/sublayer registries, hooks, state_dict,
+train/eval mode. Parameters are eager Tensors (PJRT buffers on TPU); the
+functional views used by jit.TrainStep read them as a pytree.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.tensor import Parameter, Tensor
+
+
+class ParamAttr:
+    """Analog of paddle.ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None or attr is True:
+            return ParamAttr()
+        if attr is False:
+            return None
+        if isinstance(attr, ParamAttr):
+            return attr
+        # an Initializer instance
+        return ParamAttr(initializer=attr)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        self.training = True
+        self._dtype = dtypes.canonical_name(dtype)
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- attribute routing -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            for d in (layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            for d in (params, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            else:
+                params[name] = value
+        elif layers is not None and name in layers:
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- parameter management ----------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """Analog of Layer.create_parameter (dygraph/layers.py)."""
+        from paddle_tpu.nn import initializer as I
+
+        attr = ParamAttr._to_attr(attr)
+        if attr is None:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        data = init(shape, dtype)
+        p = Parameter(data, dtype=dtype, name=attr.name or "",
+                      trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[name] = tensor
+        return tensor
+
+    # -- iteration ---------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in layer.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(sub_prefix, True)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            if l is not None:
+                out.extend(l.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            if l is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(p, include_self=True)
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, include_sublayers=True, structured_name_prefix=""):
+        out = OrderedDict()
+        for n, p in self.named_parameters(prefix=structured_name_prefix):
+            out[n] = p
+        for n, b in self.named_buffers(prefix=structured_name_prefix):
+            if b.persistable:
+                out[n] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                t.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- dtype / device movement -------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            jd = dtypes.to_jax(dtype)
+            for p in self.parameters():
+                if dtypes.is_floating(p.dtype):
+                    p._array = p._array.astype(jd)
+            for b in self.buffers():
+                if dtypes.is_floating(b.dtype):
+                    b._array = b._array.astype(jd)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dtypes.canonical_name(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, hook)
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks, hook)
+        return handle
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            rep = repr(layer).split("\n")
+            rep = [rep[0]] + ["  " + l for l in rep[1:]]
+            lines.append(f"  ({name}): " + "\n".join(rep))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, registry, hook):
+        _HookHandle._next_id += 1
+        self.hook_id = _HookHandle._next_id
+        self._registry = registry
+        registry[self.hook_id] = hook
+
+    def remove(self):
+        self._registry.pop(self.hook_id, None)

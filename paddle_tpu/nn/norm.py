@@ -1,0 +1,163 @@
+"""Normalization layers — analog of python/paddle/nn/layer/norm.py."""
+from __future__ import annotations
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import nn_ops
+
+from .layer import Layer
+
+
+class BatchNorm2D(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+        import jax.numpy as jnp
+
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        training = self.training and not self._use_global_stats
+        return nn_ops.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self._momentum, epsilon=self._epsilon,
+            data_format=self._data_format)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm1D(BatchNorm2D):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL", name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCHW")
+        self._data_format = "NCHW"  # reduce over all but axis 1 regardless
+
+
+class BatchNorm3D(BatchNorm2D):
+    pass
+
+
+BatchNorm = BatchNorm2D
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Under SPMD data parallel the batch statistics are computed over the
+    global (sharded) batch automatically when the step is compiled with a
+    'dp'-sharded mesh — cross-replica reduction is inserted by XLA. In
+    eager single-device mode it equals BatchNorm. Analog of
+    paddle.nn.SyncBatchNorm (nn/layer/norm.py)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            self._normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.layer_norm(x, self._normalized_shape, self.weight,
+                                 self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """TPU-era addition (no v2.4 analog); used by the GPT flagship."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [hidden_size], attr=weight_attr, default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return nn_ops.rms_norm(x, self.weight, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_channels], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.group_norm(x, self._num_groups, self.weight, self.bias,
+                                 self._epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        from paddle_tpu.nn import initializer as I
+
+        self._epsilon = epsilon
+        self.scale = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.instance_norm(x, self.scale, self.bias, self._epsilon)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.dispatch import apply
+
+        size, alpha, beta, k = self.size, self.alpha, self.beta, self.k
+
+        def fn(a):
+            sq = jnp.square(a)
+            half = size // 2
+            summed = jax.lax.reduce_window(
+                sq, 0.0, jax.lax.add, (1, size, 1, 1), (1, 1, 1, 1),
+                padding=[(0, 0), (half, size - 1 - half), (0, 0), (0, 0)])
+            return a / jnp.power(k + alpha * summed, beta)
+
+        return apply("lrn", fn, x)

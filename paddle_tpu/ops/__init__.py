@@ -1,0 +1,110 @@
+"""Op namespace + Tensor method installation.
+
+Analog of the reference's generated python-C op table
+(paddle/fluid/pybind/eager_op_function.cc exposed as core.eager.ops via
+python/paddle/_C_ops.py:19) and the tensor method patch
+(eager_math_op_patch.cc). Here the "registry" is plain python modules of
+jax-backed ops, and install_tensor_methods() wires them onto Tensor.
+"""
+from __future__ import annotations
+
+from paddle_tpu.core.tensor import Tensor
+
+from . import (  # noqa: F401
+    activation,
+    creation,
+    dispatch,
+    linalg,
+    manipulation,
+    math,
+    nn_ops,
+    random_ops,
+    reduction,
+)
+from .dispatch import apply, apply_nograd, as_tensor
+
+
+def _install_tensor_methods():
+    T = Tensor
+    m, r, mp, lg, act = math, reduction, manipulation, linalg, activation
+
+    # arithmetic dunders
+    T.__add__ = lambda s, o: m.add(s, o)
+    T.__radd__ = lambda s, o: m.add(o, s)
+    T.__sub__ = lambda s, o: m.subtract(s, o)
+    T.__rsub__ = lambda s, o: m.subtract(o, s)
+    T.__mul__ = lambda s, o: m.multiply(s, o)
+    T.__rmul__ = lambda s, o: m.multiply(o, s)
+    T.__truediv__ = lambda s, o: m.divide(s, o)
+    T.__rtruediv__ = lambda s, o: m.divide(o, s)
+    T.__floordiv__ = lambda s, o: m.floor_divide(s, o)
+    T.__mod__ = lambda s, o: m.mod(s, o)
+    T.__pow__ = lambda s, o: m.pow(s, o)
+    T.__rpow__ = lambda s, o: m.pow(o, s)
+    T.__matmul__ = lambda s, o: lg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: lg.matmul(o, s)
+    T.__neg__ = lambda s: m.neg(s)
+    T.__abs__ = lambda s: m.abs(s)
+    T.__invert__ = lambda s: m.logical_not(s)
+    # comparisons
+    T.__eq__ = lambda s, o: m.equal(s, o)
+    T.__ne__ = lambda s, o: m.not_equal(s, o)
+    T.__lt__ = lambda s, o: m.less_than(s, o)
+    T.__le__ = lambda s, o: m.less_equal(s, o)
+    T.__gt__ = lambda s, o: m.greater_than(s, o)
+    T.__ge__ = lambda s, o: m.greater_equal(s, o)
+    T.__and__ = lambda s, o: m.logical_and(s, o)
+    T.__or__ = lambda s, o: m.logical_or(s, o)
+    T.__xor__ = lambda s, o: m.logical_xor(s, o)
+    # indexing
+    T.__getitem__ = lambda s, item: mp.getitem(s, item)
+    T.__setitem__ = lambda s, item, v: mp.setitem(s, item, v)
+
+    # named methods (paddle Tensor method surface)
+    for name, fn in [
+        ("add", m.add), ("subtract", m.subtract), ("multiply", m.multiply),
+        ("divide", m.divide), ("mod", m.mod), ("pow", m.pow),
+        ("maximum", m.maximum), ("minimum", m.minimum),
+        ("exp", m.exp), ("log", m.log), ("sqrt", m.sqrt), ("rsqrt", m.rsqrt),
+        ("abs", m.abs), ("sign", m.sign), ("floor", m.floor), ("ceil", m.ceil),
+        ("round", m.round), ("reciprocal", m.reciprocal), ("square", m.square),
+        ("sin", m.sin), ("cos", m.cos), ("tan", m.tan), ("tanh", m.tanh),
+        ("erf", m.erf), ("clip", m.clip), ("scale", m.scale), ("cast", m.cast),
+        ("astype", m.cast), ("isnan", m.isnan), ("isinf", m.isinf),
+        ("isfinite", m.isfinite), ("equal", m.equal), ("not_equal", m.not_equal),
+        ("less_than", m.less_than), ("greater_than", m.greater_than),
+        ("logical_and", m.logical_and), ("logical_or", m.logical_or),
+        ("logical_not", m.logical_not), ("where", m.where),
+        # reductions
+        ("sum", r.sum), ("mean", r.mean), ("max", r.max), ("min", r.min),
+        ("prod", r.prod), ("std", r.std), ("var", r.var),
+        ("argmax", r.argmax), ("argmin", r.argmin), ("argsort", r.argsort),
+        ("sort", r.sort), ("topk", r.topk), ("all", r.all), ("any", r.any),
+        ("cumsum", r.cumsum), ("cumprod", r.cumprod), ("logsumexp", r.logsumexp),
+        ("unique", r.unique), ("nonzero", r.nonzero),
+        # manipulation
+        ("reshape", mp.reshape), ("flatten", mp.flatten),
+        ("squeeze", mp.squeeze), ("unsqueeze", mp.unsqueeze),
+        ("transpose", mp.transpose), ("split", mp.split), ("chunk", mp.chunk),
+        ("tile", mp.tile), ("expand", mp.expand), ("expand_as", mp.expand_as),
+        ("broadcast_to", mp.broadcast_to), ("flip", mp.flip), ("roll", mp.roll),
+        ("gather", mp.gather), ("gather_nd", mp.gather_nd),
+        ("scatter", mp.scatter), ("index_select", mp.index_select),
+        ("masked_select", mp.masked_select), ("unbind", mp.unbind),
+        ("repeat_interleave", mp.repeat_interleave), ("numel", mp.numel),
+        ("pad", mp.pad),
+        # linalg
+        ("matmul", lg.matmul), ("mm", lg.mm), ("bmm", lg.bmm), ("dot", lg.dot),
+        ("norm", lg.norm), ("dist", lg.dist), ("t", lg.t), ("trace", lg.trace),
+        ("cholesky", lg.cholesky), ("inverse", lg.inverse),
+        # activation-ish
+        ("softmax", act.softmax), ("sigmoid", act.sigmoid), ("relu", act.relu),
+    ]:
+        setattr(T, name, fn)
+
+    T.T = property(lambda s: mp.transpose(s))
+    T.item = T.item  # keep
+    T.dim = lambda s: s.ndim
+
+
+_install_tensor_methods()

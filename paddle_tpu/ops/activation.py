@@ -1,0 +1,180 @@
+"""Activation ops — analogs of paddle/phi/kernels/activation_kernel.* and
+python/paddle/nn/functional/activation.py. All are single fused jax fns;
+XLA folds them into adjacent matmuls on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply, as_tensor
+
+__all__ = [
+    "relu", "relu6", "leaky_relu", "elu", "selu", "celu", "gelu", "silu",
+    "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "softplus", "softsign", "mish", "prelu",
+    "log_sigmoid", "softmax", "log_softmax", "gumbel_softmax", "maxout",
+    "glu", "tanh",
+]
+
+
+def _unary(name, fn):
+    def op(x, *args, **kwargs):
+        x = as_tensor(x)
+        return apply(name, lambda a: fn(a, *args, **kwargs), x)
+
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+silu = _unary("silu", jax.nn.silu)
+softsign = _unary("softsign", jax.nn.soft_sign)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    x = as_tensor(x)
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0):
+    x = as_tensor(x)
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    x = as_tensor(x)
+    return apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0):
+    x = as_tensor(x)
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def gelu(x, approximate=False):
+    x = as_tensor(x)
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def swish(x):
+    return silu(x)
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    x = as_tensor(x)
+    return apply("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x):
+    x = as_tensor(x)
+    return apply("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    x = as_tensor(x)
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5):
+    x = as_tensor(x)
+    return apply(
+        "hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x
+    )
+
+
+def softshrink(x, threshold=0.5):
+    x = as_tensor(x)
+    return apply(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x,
+    )
+
+
+def tanhshrink(x):
+    x = as_tensor(x)
+    return apply("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    x = as_tensor(x)
+    return apply(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta),
+        x,
+    )
+
+
+def prelu(x, weight):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        # channel-wise (NCHW): broadcast weight over spatial dims
+        shape = [1] * a.ndim
+        shape[1] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply("prelu", fn, x, weight)
+
+
+def softmax(x, axis=-1, dtype=None):
+    from paddle_tpu.core import dtype as dtypes
+
+    x = as_tensor(x)
+
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(dtypes.to_jax(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply("softmax", fn, x)
+
+
+def log_softmax(x, axis=-1):
+    x = as_tensor(x)
+    return apply("log_softmax", lambda a: jax.nn.log_softmax(a, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from paddle_tpu.core.random import next_key
+
+    x = as_tensor(x)
+    key = next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply("gumbel_softmax", fn, x)
+
+
+def maxout(x, groups, axis=1):
+    x = as_tensor(x)
+
+    def fn(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+
+    return apply("maxout", fn, x)
+
+
+def glu(x, axis=-1):
+    x = as_tensor(x)
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)

@@ -1,0 +1,110 @@
+"""Op dispatch: the eager execution + autograd-recording boundary.
+
+TPU-native analog of the reference's generated `foo_ad_func` layer
+(paddle/fluid/eager/api/generated/.../dygraph_functions.cc, emitted by
+eager_gen.py:1049) plus PHI kernel dispatch
+(paddle/phi/core/kernel_factory.cc:158). Where the reference selects a
+(backend, layout, dtype) kernel and separately generates a GradNode per
+op, here every op is ONE pure jax function: `jax.vjp` gives both the
+forward value and the backward closure, XLA does kernel selection and
+fusion, and the same code path works under tracing (to_static).
+
+AMP autocast (the analog of eager_amp_auto_cast.h) is applied here, at
+dispatch time, before the op runs.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.autograd import Node, is_grad_enabled
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["apply", "apply_nograd", "as_tensor", "unwrap", "OpStats"]
+
+
+class OpStats:
+    """Per-op dispatch counters (profiler hook point)."""
+
+    counts: dict = {}
+    enabled = False
+
+    @classmethod
+    def record(cls, name):
+        if cls.enabled:
+            cls.counts[name] = cls.counts.get(name, 0) + 1
+
+
+def as_tensor(x, ref: Tensor = None) -> Tensor:
+    """Coerce scalars / arrays to Tensor. Python scalars adopt the ref
+    tensor's dtype (paddle scalar-promotion semantics: `x * 2.0` keeps
+    x's dtype)."""
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (bool, int, float)) and ref is not None and dtypes.is_inexact(ref.dtype):
+        return Tensor._wrap(jnp.asarray(x, ref._array.dtype))
+    if isinstance(x, (bool, int, float)) and ref is not None:
+        # int scalar with int tensor: keep tensor dtype
+        if isinstance(x, int) and not isinstance(x, bool):
+            return Tensor._wrap(jnp.asarray(x, ref._array.dtype))
+    return Tensor(x)
+
+
+def unwrap(x):
+    if isinstance(x, Tensor):
+        return x._array
+    return x
+
+
+def _wrap_outputs(out_arrays, node, needs_grad):
+    single = not isinstance(out_arrays, (tuple, list))
+    outs = [out_arrays] if single else list(out_arrays)
+    tensors = []
+    for i, arr in enumerate(outs):
+        diffable = needs_grad and jnp.issubdtype(arr.dtype, jnp.inexact)
+        t = Tensor._wrap(
+            arr,
+            stop_gradient=not diffable,
+            creator=node if diffable else None,
+            out_idx=i,
+        )
+        tensors.append(t)
+    return tensors[0] if single else tuple(tensors)
+
+
+def apply(name: str, fn: Callable, *inputs: Tensor, amp_policy: str = None):
+    """Run differentiable op `fn(*arrays)`; record a tape Node if needed.
+
+    `fn` must be a pure function of the input arrays (static attrs go in
+    the closure). Returns Tensor or tuple of Tensors.
+    """
+    OpStats.record(name)
+    from paddle_tpu.amp.auto_cast import maybe_autocast  # lazy; amp optional
+
+    inputs = maybe_autocast(name, inputs, amp_policy)
+    arrays = [t._array for t in inputs]
+    needs_grad = is_grad_enabled() and any(
+        (not t.stop_gradient) and jnp.issubdtype(t._array.dtype, jnp.inexact)
+        for t in inputs
+    )
+    if not needs_grad:
+        out = fn(*arrays)
+        return _wrap_outputs(out, None, False)
+
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    out_specs = [(o.shape, o.dtype) for o in outs]
+    node = Node(name, vjp_fn, inputs, out_specs)
+    return _wrap_outputs(out, node, True)
+
+
+def apply_nograd(name: str, fn: Callable, *inputs: Tensor):
+    """Run a non-differentiable op (comparisons, argmax, casts to int...)."""
+    OpStats.record(name)
+    arrays = [t._array for t in inputs]
+    out = fn(*arrays)
+    return _wrap_outputs(out, None, False)

@@ -1,0 +1,202 @@
+"""Linear algebra ops — analog of python/paddle/tensor/linalg.py.
+
+matmul is THE op on TPU: it maps onto the 128x128 MXU systolic array. We
+request bf16-friendly `preferred_element_type` so mixed-precision
+accumulation stays fp32 even when activations are bf16.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+from .dispatch import apply, as_tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "outer", "inner", "t", "norm", "dist",
+    "cross", "cholesky", "inverse", "pinv", "solve", "triangular_solve",
+    "svd", "qr", "eigh", "det", "slogdet", "matrix_power", "trace",
+    "diagonal", "kron", "mv", "histogram",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        # accumulate in fp32 on the MXU regardless of input precision
+        pet = jnp.float32 if jnp.issubdtype(a.dtype, jnp.floating) else None
+        out = jnp.matmul(a, b, preferred_element_type=pet)
+        return out.astype(jnp.promote_types(a.dtype, b.dtype)) if pet else out
+
+    return apply("matmul", fn, x, y)
+
+
+def mm(x, y):
+    return matmul(x, y)
+
+
+def bmm(x, y):
+    return matmul(x, y)
+
+
+def dot(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def outer(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def inner(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("inner", lambda a, b: jnp.inner(a, b), x, y)
+
+
+def t(x):
+    x = as_tensor(x)
+    if x.ndim < 2:
+        from .manipulation import clone
+
+        return clone(x)
+    return apply("t", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def mv(x, vec):
+    x, vec = as_tensor(x), as_tensor(vec)
+    return apply("mv", lambda a, v: jnp.matmul(a, v), x, vec)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    x = as_tensor(x)
+
+    def fn(a):
+        if p == "fro" or (p == 2 and axis is None):
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=keepdim))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(a), axis=axis, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=keepdim), 1.0 / p
+        )
+
+    return apply("norm", fn, x)
+
+
+def dist(x, y, p=2):
+    from .math import subtract
+
+    return norm(subtract(x, y), p=float(p) if p != 2 else 2)
+
+
+def cross(x, y, axis=-1):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("cross", lambda a, b: jnp.cross(a, b, axis=axis), x, y)
+
+
+def cholesky(x, upper=False):
+    x = as_tensor(x)
+
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply("cholesky", fn, x)
+
+
+def inverse(x):
+    x = as_tensor(x)
+    return apply("inverse", lambda a: jnp.linalg.inv(a), x)
+
+
+def pinv(x, rcond=1e-15):
+    x = as_tensor(x)
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond), x)
+
+
+def solve(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("solve", lambda a, b: jnp.linalg.solve(a, b), x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular,
+        )
+
+    return apply("triangular_solve", fn, x, y)
+
+
+def svd(x, full_matrices=False):
+    x = as_tensor(x)
+    return apply("svd", lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), x)
+
+
+def qr(x, mode="reduced"):
+    x = as_tensor(x)
+    return apply("qr", lambda a: jnp.linalg.qr(a, mode=mode), x)
+
+
+def eigh(x, UPLO="L"):
+    x = as_tensor(x)
+    return apply("eigh", lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x)
+
+
+def det(x):
+    x = as_tensor(x)
+    return apply("det", lambda a: jnp.linalg.det(a), x)
+
+
+def slogdet(x):
+    x = as_tensor(x)
+    return apply("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), x)
+
+
+def matrix_power(x, n):
+    x = as_tensor(x)
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    x = as_tensor(x)
+    return apply("trace", lambda a: jnp.trace(a, offset, axis1, axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    x = as_tensor(x)
+    return apply("diagonal", lambda a: jnp.diagonal(a, offset, axis1, axis2), x)
+
+
+def kron(x, y):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("kron", lambda a, b: jnp.kron(a, b), x, y)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    from .dispatch import apply_nograd
+
+    x = as_tensor(x)
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+
+    def fn(a):
+        rng = (lo, hi) if lo is not None else (a.min(), a.max())
+        h, _ = jnp.histogram(a, bins=bins, range=rng)
+        return h
+
+    return apply_nograd("histogram", fn, x)

@@ -1,0 +1,340 @@
+"""Shape/layout manipulation ops — analog of python/paddle/tensor/manipulation.py."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+builtins_slice = builtins.slice
+
+from paddle_tpu.core.tensor import Tensor
+
+from .dispatch import apply, apply_nograd, as_tensor
+
+__all__ = [
+    "reshape", "flatten", "squeeze", "unsqueeze", "transpose", "moveaxis",
+    "concat", "stack", "split", "chunk", "unbind", "tile", "expand",
+    "expand_as", "broadcast_to", "flip", "roll", "gather", "gather_nd",
+    "scatter", "index_select", "masked_select", "take_along_axis",
+    "put_along_axis", "slice", "strided_slice", "getitem", "clone",
+    "repeat_interleave", "unstack", "as_complex", "as_real", "pad",
+    "crop", "rot90", "numel", "tensordot", "squeeze_", "unsqueeze_",
+]
+
+
+def clone(x):
+    x = as_tensor(x)
+    return apply("clone", lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact) else jnp.array(a), x)
+
+
+def reshape(x, shape):
+    x = as_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = tuple(int(s) for s in shape)
+    return apply("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    x = as_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+    new_shape = x.shape[:sa] + [-1] + x.shape[so + 1:]
+    return reshape(x, new_shape)
+
+
+def _norm_axes(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (int, np.integer)):
+        return (int(axis) % ndim if ndim else 0,)
+    return tuple(int(a) % ndim for a in axis)
+
+
+def squeeze(x, axis=None):
+    x = as_tensor(x)
+    axes = _norm_axes(axis, x.ndim)
+    if axes is not None:
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+        if not axes:
+            return clone(x)
+    return apply("squeeze", lambda a: jnp.squeeze(a, axes), x)
+
+
+def unsqueeze(x, axis):
+    x = as_tensor(x)
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return apply("unsqueeze", lambda a: jnp.expand_dims(a, tuple(axis)), x)
+
+
+squeeze_ = squeeze
+unsqueeze_ = unsqueeze
+
+
+def transpose(x, perm=None):
+    x = as_tensor(x)
+    if perm is None:
+        perm = list(range(x.ndim))[::-1]
+    perm = tuple(int(p) for p in perm)
+    return apply("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def moveaxis(x, source, destination):
+    x = as_tensor(x)
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def concat(xs, axis=0):
+    ts = [as_tensor(t) for t in xs]
+    axis = int(axis if not isinstance(axis, Tensor) else axis.item())
+    return apply("concat", lambda *arrs: jnp.concatenate(arrs, axis=axis), *ts)
+
+
+def stack(xs, axis=0):
+    ts = [as_tensor(t) for t in xs]
+    return apply("stack", lambda *arrs: jnp.stack(arrs, axis=axis), *ts)
+
+
+def split(x, num_or_sections, axis=0):
+    x = as_tensor(x)
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, (int, np.integer)):
+        n = int(num_or_sections)
+        if dim % n != 0:
+            raise ValueError(
+                f"split: axis {axis} size {dim} is not divisible by {n} "
+                f"(paddle semantics; pass explicit section sizes instead)")
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            sizes[neg[0]] = dim - sum(s for s in sizes if s >= 0)
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(a):
+        return tuple(
+            jnp.take(a, jnp.arange(o, o + s), axis=axis) for o, s in zip(offsets, sizes)
+        )
+
+    return list(apply("split", fn, x)) if len(sizes) > 1 else [clone(x)]
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    x = as_tensor(x)
+    n = x.shape[axis]
+
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis))
+
+    return list(apply("unbind", fn, x))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times):
+    x = as_tensor(x)
+    rt = tuple(int(r) for r in repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, rt), x)
+
+
+def expand(x, shape):
+    x = as_tensor(x)
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if int(s) == -1 else int(s)
+        for i, s in enumerate(shape)
+    )
+    return apply("expand", lambda a: jnp.broadcast_to(a, shape), x)
+
+
+def expand_as(x, y):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape):
+    return expand(x, shape)
+
+
+def flip(x, axis):
+    x = as_tensor(x)
+    if isinstance(axis, (int, np.integer)):
+        axis = [int(axis)]
+    return apply("flip", lambda a: jnp.flip(a, tuple(axis)), x)
+
+
+def roll(x, shifts, axis=None):
+    x = as_tensor(x)
+    return apply("roll", lambda a: jnp.roll(a, shifts, axis), x)
+
+
+def gather(x, index, axis=0):
+    x = as_tensor(x)
+    idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1) if idx.ndim > 1 else idx
+    return apply("gather", lambda a: jnp.take(a, idx, axis=axis), x)
+
+
+def gather_nd(x, index):
+    x = as_tensor(x)
+    idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply("gather_nd", fn, x)
+
+
+def scatter(x, index, updates, overwrite=True):
+    x = as_tensor(x)
+    updates = as_tensor(updates, x)
+    idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        return a.at[idx].add(u)
+
+    return apply("scatter", fn, x, updates)
+
+
+def index_select(x, index, axis=0):
+    return gather(x, index, axis)
+
+
+def masked_select(x, mask):
+    # dynamic shape: host-side only (not jittable); paddle semantics
+    x = as_tensor(x)
+    m = mask._array if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return apply_nograd("masked_select", lambda a: a[np.asarray(m)], x)
+
+
+def take_along_axis(x, indices, axis):
+    x = as_tensor(x)
+    idx = indices._array if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply("take_along_axis", lambda a: jnp.take_along_axis(a, idx, axis=axis), x)
+
+
+def put_along_axis(x, indices, values, axis):
+    x = as_tensor(x)
+    values = as_tensor(values, x)
+    idx = indices._array if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    def fn(a, v):
+        return jnp.put_along_axis(a, idx, v, axis=axis, inplace=False)
+
+    return apply("put_along_axis", fn, x, values)
+
+
+def slice(x, axes, starts, ends):
+    x = as_tensor(x)
+    slices = [builtins_slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = builtins_slice(int(st), int(en))
+    sl = tuple(slices)
+    return apply("slice", lambda a: a[sl], x)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = as_tensor(x)
+    slices = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = builtins_slice(int(st), int(en), int(sd))
+    sl = tuple(slices)
+    return apply("strided_slice", lambda a: a[sl], x)
+
+
+def _prep_index(item):
+    """Convert Tensor indices inside a getitem key to raw arrays."""
+    if isinstance(item, Tensor):
+        return item._array
+    if isinstance(item, tuple):
+        return tuple(_prep_index(i) for i in item)
+    if isinstance(item, list):
+        return [_prep_index(i) for i in item]
+    return item
+
+
+def getitem(x, item):
+    x = as_tensor(x)
+    key = _prep_index(item)
+    return apply("getitem", lambda a: a[key], x)
+
+
+def setitem(x, item, value):
+    """In-place __setitem__ via functional .at[] update (eager only)."""
+    key = _prep_index(item)
+    v = value._array if isinstance(value, Tensor) else jnp.asarray(value)
+    x._array = x._array.at[key].set(v.astype(x._array.dtype) if hasattr(v, "astype") else v)
+    return x
+
+
+def repeat_interleave(x, repeats, axis=None):
+    x = as_tensor(x)
+    r = repeats._array if isinstance(repeats, Tensor) else repeats
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), x)
+
+
+def as_complex(x):
+    x = as_tensor(x)
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x):
+    x = as_tensor(x)
+    return apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    x = as_tensor(x)
+    nd = x.ndim
+    if len(pad) == nd * 2:
+        cfg = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to last len(pad)//2 spatial dims of
+        # NCHW/NHWC layout, ordered (left,right,top,bottom,...)
+        npairs = len(pad) // 2
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1])) for i in range(npairs)]
+        pairs = pairs[::-1]  # paddle lists W first, numpy wants outermost first
+        cfg = [(0, 0)] * (nd - npairs) + pairs
+        if data_format.endswith("C") and nd - npairs >= 2:  # NHWC: channel last
+            cfg = [(0, 0)] + cfg[2:] + [(0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return apply("pad", lambda a: jnp.pad(a, cfg, mode="constant", constant_values=value), x)
+    return apply("pad", lambda a: jnp.pad(a, cfg, mode=jmode), x)
+
+
+def crop(x, shape, offsets=None):
+    x = as_tensor(x)
+    if offsets is None:
+        offsets = [0] * x.ndim
+    sl = tuple(
+        builtins_slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape)
+    )
+    return apply("crop", lambda a: a[sl], x)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    x = as_tensor(x)
+    return apply("rot90", lambda a: jnp.rot90(a, k, axes), x)
+
+
+def numel(x):
+    return Tensor._wrap(jnp.asarray(int(np.prod(x._array.shape)) if x._array.shape else 1))
+
+
+def tensordot(x, y, axes=2):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes), x, y)

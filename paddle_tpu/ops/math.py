@@ -1,0 +1,230 @@
+"""Elementwise / binary math ops — analog of python/paddle/tensor/math.py.
+
+Each op is a pure jax fn passed through dispatch.apply; XLA fuses chains
+of these into single kernels when run under jit, and the VJPs come from
+jax.vjp instead of hand-written grad kernels
+(cf. paddle/phi/kernels/elementwise_*).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+from .dispatch import apply, apply_nograd, as_tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
+    "maximum", "minimum", "fmax", "fmin", "atan2",
+    "exp", "log", "log2", "log10", "log1p", "expm1", "sqrt", "rsqrt",
+    "abs", "neg", "sign", "floor", "ceil", "round", "trunc", "reciprocal",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "erf", "erfinv", "square",
+    "clip", "scale", "lerp", "addmm",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "isnan", "isinf", "isfinite", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "where", "cast", "increment", "stanh", "multiplex",
+    "nan_to_num",
+]
+
+
+def _binary(name, fn):
+    def op(x, y):
+        if not isinstance(x, Tensor):
+            x = as_tensor(x, y if isinstance(y, Tensor) else None)
+        y = as_tensor(y, x)
+        xa, ya = x._array, y._array
+        # match dtypes (paddle promotes to the "higher" dtype)
+        if xa.dtype != ya.dtype:
+            common = jnp.promote_types(xa.dtype, ya.dtype)
+            return apply(name, lambda a, b: fn(a.astype(common), b.astype(common)), x, y)
+        return apply(name, fn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+def _binary_nograd(name, fn):
+    def op(x, y):
+        if not isinstance(x, Tensor):
+            x = as_tensor(x, y if isinstance(y, Tensor) else None)
+        y = as_tensor(y, x)
+        return apply_nograd(name, fn, x, y)
+
+    op.__name__ = name
+    return op
+
+
+def _unary(name, fn):
+    def op(x):
+        x = as_tensor(x)
+        return apply(name, fn, x)
+
+    op.__name__ = name
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", lambda a, b: jnp.divide(a, b))
+floor_divide = _binary_nograd("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+
+exp = _unary("exp", jnp.exp)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lambda a: jax.lax.rsqrt(a))
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+sign = _unary("sign", jnp.sign)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+square = _unary("square", jnp.square)
+
+
+def clip(x, min=None, max=None):
+    x = as_tensor(x)
+    lo = min._array if isinstance(min, Tensor) else min
+    hi = max._array if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    x = as_tensor(x)
+    s, b = float(scale), float(bias)
+    if bias_after_scale:
+        out = apply("scale", lambda a: a * s + b, x)
+    else:
+        out = apply("scale", lambda a: (a + b) * s, x)
+    if act is not None:
+        from . import activation
+
+        out = getattr(activation, act)(out)
+    return out
+
+
+def lerp(x, y, weight):
+    x, y = as_tensor(x), as_tensor(y)
+    w = weight._array if isinstance(weight, Tensor) else weight
+    return apply("lerp", lambda a, b: a + w * (b - a), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    input, x, y = as_tensor(input), as_tensor(x), as_tensor(y)
+    return apply(
+        "addmm", lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y
+    )
+
+
+equal = _binary_nograd("equal", jnp.equal)
+not_equal = _binary_nograd("not_equal", jnp.not_equal)
+less_than = _binary_nograd("less_than", jnp.less)
+less_equal = _binary_nograd("less_equal", jnp.less_equal)
+greater_than = _binary_nograd("greater_than", jnp.greater)
+greater_equal = _binary_nograd("greater_equal", jnp.greater_equal)
+logical_and = _binary_nograd("logical_and", jnp.logical_and)
+logical_or = _binary_nograd("logical_or", jnp.logical_or)
+logical_xor = _binary_nograd("logical_xor", jnp.logical_xor)
+bitwise_and = _binary_nograd("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary_nograd("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary_nograd("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x):
+    return apply_nograd("logical_not", jnp.logical_not, as_tensor(x))
+
+
+def bitwise_not(x):
+    return apply_nograd("bitwise_not", jnp.bitwise_not, as_tensor(x))
+
+
+def isnan(x):
+    return apply_nograd("isnan", jnp.isnan, as_tensor(x))
+
+
+def isinf(x):
+    return apply_nograd("isinf", jnp.isinf, as_tensor(x))
+
+
+def isfinite(x):
+    return apply_nograd("isfinite", jnp.isfinite, as_tensor(x))
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        arr = condition._array if isinstance(condition, Tensor) else jnp.asarray(condition)
+        return tuple(Tensor._wrap(i) for i in jnp.nonzero(arr))
+    cond = condition._array if isinstance(condition, Tensor) else jnp.asarray(condition)
+    x, y = as_tensor(x), as_tensor(y, x)
+    return apply("where", lambda a, b: jnp.where(cond, a, b), x, y)
+
+
+def cast(x, dtype):
+    from paddle_tpu.core import dtype as dtypes
+
+    x = as_tensor(x)
+    jd = dtypes.to_jax(dtype)
+    if jnp.issubdtype(jd, jnp.inexact) and jnp.issubdtype(x._array.dtype, jnp.inexact):
+        return apply("cast", lambda a: a.astype(jd), x)
+    return apply_nograd("cast", lambda a: a.astype(jd), x)
+
+
+def increment(x, value=1.0):
+    x._array = x._array + value
+    return x
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    x = as_tensor(x)
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index):
+    idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
+    idx = idx.reshape(-1)
+    ts = [as_tensor(t) for t in inputs]
+
+    def fn(*arrs):
+        stacked = jnp.stack(arrs, axis=0)  # [n, batch, ...]
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+
+    return apply("multiplex", fn, *ts)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    x = as_tensor(x)
+    return apply(
+        "nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x
+    )

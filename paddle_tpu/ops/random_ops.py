@@ -1,0 +1,141 @@
+"""Random sampling ops — analog of python/paddle/tensor/random.py.
+
+Every op draws a fresh subkey from the global Generator (core/random.py),
+the functional analog of the reference's stateful Philox generator
+(paddle/phi/core/generator.h:23).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtype as dtypes
+from paddle_tpu.core.random import next_key
+from paddle_tpu.core.tensor import Tensor
+
+from .creation import _shape_tuple
+from .dispatch import apply_nograd, as_tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
+    "exponential", "shuffle", "uniform_", "normal_",
+]
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    d = dtypes.to_jax(dtype)
+    return Tensor._wrap(jax.random.normal(next_key(), _shape_tuple(shape), d))
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    d = dtypes.to_jax(dtype)
+    return Tensor._wrap(
+        jax.random.uniform(next_key(), _shape_tuple(shape), d, minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._array if isinstance(mean, Tensor) else mean
+        s = std._array if isinstance(std, Tensor) else std
+        shp = m.shape if hasattr(m, "shape") else s.shape
+        return Tensor._wrap(m + s * jax.random.normal(next_key(), shp))
+    d = dtypes.to_jax(None)
+    return Tensor._wrap(
+        mean + std * jax.random.normal(next_key(), _shape_tuple(shape), d)
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    d = dtypes.to_jax(dtype)
+    return Tensor._wrap(
+        jax.random.randint(next_key(), _shape_tuple(shape), low, high, d)
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    x = as_tensor(x)
+    return randint(low, high, tuple(x.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64"):
+    return Tensor._wrap(
+        jax.random.permutation(next_key(), n).astype(dtypes.to_jax(dtype))
+    )
+
+
+def bernoulli(x):
+    x = as_tensor(x)
+    key = next_key()
+    return apply_nograd(
+        "bernoulli", lambda a: jax.random.bernoulli(key, a).astype(a.dtype), x
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    x = as_tensor(x)
+    key = next_key()
+
+    def fn(a):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, axis=-1, shape=(num_samples,) + a.shape[:-1]
+            ).T if a.ndim > 1 else jax.random.categorical(
+                key, logits, shape=(num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, a.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+
+    out = apply_nograd("multinomial", fn, x)
+    return out
+
+
+def poisson(x):
+    x = as_tensor(x)
+    key = next_key()
+    return apply_nograd(
+        "poisson", lambda a: jax.random.poisson(key, a).astype(a.dtype), x
+    )
+
+
+def exponential(x, lam=1.0):
+    x = as_tensor(x)
+    key = next_key()
+    return apply_nograd(
+        "exponential",
+        lambda a: (jax.random.exponential(key, a.shape, a.dtype) / lam),
+        x,
+    )
+
+
+def shuffle(x, axis=0):
+    x = as_tensor(x)
+    key = next_key()
+    return apply_nograd(
+        "shuffle", lambda a: jax.random.permutation(key, a, axis=axis), x
+    )
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    x._array = jax.random.uniform(
+        next_key(), x._array.shape, x._array.dtype, minval=min, maxval=max
+    )
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0):
+    x._array = mean + std * jax.random.normal(next_key(), x._array.shape, x._array.dtype)
+    return x

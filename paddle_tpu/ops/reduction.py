@@ -1,0 +1,244 @@
+"""Reduction & search ops — analogs of reduce_* kernels
+(paddle/phi/kernels/funcs/reduce_*) and python/paddle/tensor/{math,search,stat}.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+from .dispatch import apply, apply_nograd, as_tensor
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "std", "var", "median",
+    "argmax", "argmin", "argsort", "sort", "topk", "all", "any",
+    "cumsum", "cumprod", "logsumexp", "amax", "amin", "count_nonzero",
+    "nansum", "nanmean", "kthvalue", "mode", "unique", "nonzero",
+    "quantile", "bincount",
+]
+
+
+def _axes(axis, ndim):
+    if axis is None:
+        return None
+    if isinstance(axis, (int, np.integer)):
+        return int(axis)
+    return tuple(int(a) for a in axis)
+
+
+def _reduce(name, fn, grad_ok=True):
+    def op(x, axis=None, keepdim=False):
+        x = as_tensor(x)
+        ax = _axes(axis, x.ndim)
+        f = lambda a: fn(a, axis=ax, keepdims=keepdim)
+        return apply(name, f, x) if grad_ok else apply_nograd(name, f, x)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+prod = _reduce("prod", jnp.prod)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+logsumexp = _reduce("logsumexp", lambda a, axis, keepdims: jnp.log(
+    jnp.sum(jnp.exp(a - jnp.max(a, axis=axis, keepdims=True)), axis=axis, keepdims=keepdims)
+) + (jnp.max(a, axis=axis, keepdims=keepdims)))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    ddof = 1 if unbiased else 0
+    return apply("std", lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    ddof = 1 if unbiased else 0
+    return apply("var", lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    return apply("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    return apply("quantile", lambda a: jnp.quantile(a, q, axis=ax, keepdims=keepdim), x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from paddle_tpu.core import dtype as dtypes
+
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+
+    def fn(a):
+        r = jnp.argmax(a, axis=ax, keepdims=keepdim if ax is not None else False)
+        return r.astype(dtypes.to_jax(dtype))
+
+    return apply_nograd("argmax", fn, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from paddle_tpu.core import dtype as dtypes
+
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+
+    def fn(a):
+        r = jnp.argmin(a, axis=ax, keepdims=keepdim if ax is not None else False)
+        return r.astype(dtypes.to_jax(dtype))
+
+    return apply_nograd("argmin", fn, x)
+
+
+def argsort(x, axis=-1, descending=False):
+    x = as_tensor(x)
+
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis)
+        return jnp.flip(idx, axis=axis) if descending else idx
+
+    return apply_nograd("argsort", fn, x)
+
+
+def sort(x, axis=-1, descending=False):
+    x = as_tensor(x)
+
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return apply("sort", fn, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    import jax.lax
+
+    x = as_tensor(x)
+    k = int(k)
+    axis_ = axis
+
+    def fn(a):
+        a2 = jnp.moveaxis(a, axis_, -1)
+        if largest:
+            v, i = jax.lax.top_k(a2, k)
+        else:
+            v, i = jax.lax.top_k(-a2, k)
+            v = -v
+        return jnp.moveaxis(v, -1, axis_), jnp.moveaxis(i, -1, axis_).astype(jnp.int32)
+
+    values, indices = apply("topk", fn, x)
+    return values, indices
+
+
+def all(x, axis=None, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    return apply_nograd("all", lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    return apply_nograd("any", lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None):
+    x = as_tensor(x)
+    if axis is None:
+        return apply("cumsum", lambda a: jnp.cumsum(a.reshape(-1)), x)
+    return apply("cumsum", lambda a: jnp.cumsum(a, axis=int(axis)), x)
+
+
+def cumprod(x, dim=None):
+    x = as_tensor(x)
+    return apply("cumprod", lambda a: jnp.cumprod(a, axis=dim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    x = as_tensor(x)
+    ax = _axes(axis, x.ndim)
+    return apply_nograd(
+        "count_nonzero", lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim), x
+    )
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    x = as_tensor(x)
+
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix.astype(jnp.int32)
+
+    return apply("kthvalue", fn, x)
+
+
+def mode(x, axis=-1, keepdim=False):
+    x = as_tensor(x)
+
+    def fn(a):
+        # mode via sort: the most frequent value ends a maximal run
+        s = jnp.sort(a, axis=axis)
+        same = jnp.concatenate(
+            [jnp.zeros_like(jnp.take(s, jnp.array([0]), axis=axis), dtype=jnp.int32),
+             (jnp.diff(s, axis=axis) == 0).astype(jnp.int32)], axis=axis)
+        run = jnp.cumsum(same, axis=axis) - jnp.cumsum(
+            jnp.where(same == 0, jnp.cumsum(same, axis=axis), 0), axis=axis
+        )
+        best = jnp.argmax(run, axis=axis, keepdims=True)
+        v = jnp.take_along_axis(s, best, axis=axis)
+        if not keepdim:
+            v = jnp.squeeze(v, axis)
+        return v
+
+    return apply_nograd("mode", fn, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    # dynamic output shape -> host-side eager only
+    x = as_tensor(x)
+    res = np.unique(
+        np.asarray(x._array),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(Tensor(np.asarray(r)) for r in res)
+    return Tensor(np.asarray(res))
+
+
+def nonzero(x, as_tuple=False):
+    x = as_tensor(x)
+    idx = np.nonzero(np.asarray(x._array))
+    if as_tuple:
+        return tuple(Tensor(i) for i in idx)
+    return Tensor(np.stack(idx, axis=-1))
+
+
+def bincount(x, weights=None, minlength=0):
+    x = as_tensor(x)
+    w = weights._array if isinstance(weights, Tensor) else weights
+    return apply_nograd(
+        "bincount", lambda a: jnp.bincount(a, weights=w, minlength=minlength), x
+    )

@@ -1,0 +1,17 @@
+from . import lr
+from .optimizer import Optimizer
+from .optimizers import (
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    AdamW,
+    Lamb,
+    Momentum,
+    RMSProp,
+)
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
+    "Adadelta", "Lamb", "lr",
+]

@@ -1,0 +1,181 @@
+"""Optimizer base — analog of python/paddle/optimizer/optimizer.py (the
+_create_accumulators/_append_optimize_op pattern). TPU-native twist: the
+whole update (all params, all accumulators) is ONE jitted pytree function
+with donated buffers, so eager `opt.step()` costs a single XLA execution
+instead of per-param kernel launches.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph mode)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float) or isinstance(weight_decay, int):
+            self._weight_decay = float(weight_decay)
+        else:
+            self._weight_decay = weight_decay if weight_decay is None else float(weight_decay)
+        # per-parameter accumulator slots: name -> list aligned with params
+        self._accumulators: Dict[str, List] = {}
+        self._step_count = 0
+        self._jitted_update = None
+
+    # -- subclass interface -------------------------------------------------
+    def _create_accumulators(self):
+        """Return dict name -> list of zero-initialized arrays per param."""
+        return {}
+
+    def _single_update(self, param, grad, accums, lr, step, extras=None):
+        """Pure function: (param, grad, {name: acc}, lr, step, extras) ->
+        (new_param, {name: new_acc}). Must be jax-traceable. `extras` is
+        the per-parameter dict from _per_param_extras (e.g. AdamW's decay
+        mask)."""
+        raise NotImplementedError
+
+    def _per_param_extras(self, i):
+        """Per-parameter traced scalars passed to _single_update."""
+        return {}
+
+    # -- public api ----------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("can't set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def _ensure_state(self):
+        if not self._accumulators and type(self)._create_accumulators is not Optimizer._create_accumulators:
+            self._accumulators = self._create_accumulators()
+
+    def _build_jitted_update(self):
+        single = self._single_update
+        wd = self._weight_decay
+
+        def update_all(params, grads, accums, lr, step, extras):
+            new_params, new_accums = [], []
+            for i, (p, g) in enumerate(zip(params, grads)):
+                acc_i = {k: v[i] for k, v in accums.items()}
+                if g is None:
+                    new_params.append(p)
+                    new_accums.append(acc_i)
+                    continue
+                np_, na = single(p, g, acc_i, lr, step, extras=extras[i])
+                new_params.append(np_)
+                new_accums.append(na)
+            out_acc = {
+                k: [na.get(k, accums[k][i]) for i, na in enumerate(new_accums)]
+                for k in accums
+            }
+            return new_params, out_acc
+
+        # donate param + accumulator buffers: in-place update on TPU HBM
+        return jax.jit(update_all, static_argnames=(), donate_argnums=(0, 2))
+
+    @property
+    def _params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            pg.append((p, p.grad))
+        return pg
+
+    def step(self):
+        self._ensure_state()
+        pg = self._params_grads
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+
+        params = [p._array for p, _ in pg]
+        grads = [g._array if g is not None else None for _, g in pg]
+        if builtins_all(g is None for g in grads):
+            return
+        # jit can't take None leaves in a donated list: substitute zeros mask
+        # by splitting indices
+        live_idx = [i for i, g in enumerate(grads) if g is not None]
+        if self._jitted_update is None:
+            self._jitted_update = self._build_jitted_update()
+
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.int32)
+
+        live_params = [params[i] for i in live_idx]
+        live_grads = [grads[i] for i in live_idx]
+        live_accums = {k: [v[i] for i in live_idx] for k, v in self._accumulators.items()}
+        live_extras = [self._per_param_extras(i) for i in live_idx]
+
+        new_params, new_accums = self._jitted_update(
+            live_params, live_grads, live_accums, lr, step, live_extras)
+
+        for j, i in enumerate(live_idx):
+            self._parameter_list[i]._in_place_update(new_params[j])
+            for k in self._accumulators:
+                self._accumulators[k][i] = new_accums[k][j]
+        self._step_count += 1
+        if isinstance(self._learning_rate, LRScheduler):
+            pass  # stepping the scheduler is the user's job (paddle semantics)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- state dict -----------------------------------------------------------
+    def state_dict(self):
+        self._ensure_state()
+        out = {"_step_count": self._step_count}
+        import numpy as np
+
+        for k, lst in self._accumulators.items():
+            for i, a in enumerate(lst):
+                out[f"{k}_{i}"] = Tensor._wrap(a) if a is not None else None
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._ensure_state()
+        self._step_count = int(state.get("_step_count", 0))
+        for k, lst in self._accumulators.items():
+            for i in range(len(lst)):
+                key = f"{k}_{i}"
+                if key in state and state[key] is not None:
+                    v = state[key]
+                    arr = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                    lst[i] = arr
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+
+    # -- helpers --------------------------------------------------------------
+    def _zeros_like_params(self, dtype=None):
+        return [
+            jnp.zeros(p._array.shape, dtype or p._array.dtype)
+            for p in self._parameter_list
+        ]
+
+
+import builtins  # noqa: E402
+
+builtins_all = builtins.all

@@ -1,0 +1,243 @@
+"""Concrete optimizers — analogs of python/paddle/optimizer/{sgd,momentum,
+adam,adamw,adagrad,rmsprop,adadelta,lamb}.py. Update rules are pure jax
+fns compiled (with donation) by the Optimizer base into a single fused
+XLA update per step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        new_p = p.astype(jnp.float32) - lr * g
+        return new_p.astype(p.dtype), {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_accumulators(self):
+        return {"velocity": self._zeros_like_params(jnp.float32)}
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        v = self._momentum * acc["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        new_p = p.astype(jnp.float32) - lr * upd
+        return new_p.astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self):
+        return {
+            "moment1": self._zeros_like_params(jnp.float32),
+            "moment2": self._zeros_like_params(jnp.float32),
+        }
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * pf
+        t = (step + 1).astype(jnp.float32)
+        m = self._beta1 * acc["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * acc["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(self._beta1, t))
+        vhat = v / (1 - jnp.power(self._beta2, t))
+        new_p = pf - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._wd = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_mask = None
+
+    def _ensure_state(self):
+        super()._ensure_state()
+        if self._decay_mask is None:
+            if self._apply_decay_param_fun is not None:
+                self._decay_mask = [
+                    bool(self._apply_decay_param_fun(p.name))
+                    for p in self._parameter_list
+                ]
+            else:
+                self._decay_mask = [True] * len(self._parameter_list)
+
+    def step(self):
+        self._ensure_state()
+        super().step()
+
+    def _per_param_extras(self, i):
+        self._ensure_state()
+        return {"decay": jnp.asarray(
+            self._wd if self._decay_mask[i] else 0.0, jnp.float32)}
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        wd = extras["decay"] if extras else self._wd
+        t = (step + 1).astype(jnp.float32)
+        m = self._beta1 * acc["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * acc["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(self._beta1, t))
+        vhat = v / (1 - jnp.power(self._beta2, t))
+        new_p = pf - lr * (mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * pf)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_accumulators(self):
+        return {
+            "moment": [
+                jnp.full(p._array.shape, self._init_acc, jnp.float32)
+                for p in self._parameter_list
+            ]
+        }
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        mom = acc["moment"] + jnp.square(g)
+        new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(mom) + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self):
+        out = {
+            "mean_square": self._zeros_like_params(jnp.float32),
+            "momentum": self._zeros_like_params(jnp.float32),
+        }
+        if self._centered:
+            out["mean_grad"] = self._zeros_like_params(jnp.float32)
+        return out
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        ms = self._rho * acc["mean_square"] + (1 - self._rho) * jnp.square(g)
+        out_acc = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * acc["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out_acc["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * acc["momentum"] + lr * g / denom
+        out_acc["momentum"] = mom
+        new_p = p.astype(jnp.float32) - mom
+        return new_p.astype(p.dtype), out_acc
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self):
+        return {
+            "avg_squared_grad": self._zeros_like_params(jnp.float32),
+            "avg_squared_update": self._zeros_like_params(jnp.float32),
+        }
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p.astype(jnp.float32)
+        asg = self._rho * acc["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(acc["avg_squared_update"] + self._epsilon) / jnp.sqrt(
+            asg + self._epsilon)
+        asu = self._rho * acc["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        new_p = p.astype(jnp.float32) - lr * upd
+        return new_p.astype(p.dtype), {
+            "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    """LAMB (paddle/optimizer/lamb.py; meta_optimizers/lamb_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self):
+        return {
+            "moment1": self._zeros_like_params(jnp.float32),
+            "moment2": self._zeros_like_params(jnp.float32),
+        }
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        t = (step + 1).astype(jnp.float32)
+        m = self._beta1 * acc["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * acc["moment2"] + (1 - self._beta2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(self._beta1, t))
+        vhat = v / (1 - jnp.power(self._beta2, t))
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._wd * pf
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - lr * trust * r
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}

@@ -1,0 +1,21 @@
+"""Profiler — analog of python/paddle/profiler/ (profiler.py:344).
+
+Host spans (RecordEvent, the analog of platform/profiler/event_tracing.h)
+are recorded into a ring buffer and exported as chrome://tracing JSON
+(ChromeTracingLogger analog). Device-side timing comes from jax.profiler
+(XPlane/TensorBoard) when a trace dir is given — the CUPTI analog on TPU.
+"""
+from .profiler import (
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    export_chrome_tracing,
+    make_scheduler,
+)
+from .utils import SummaryView
+
+__all__ = [
+    "Profiler", "RecordEvent", "ProfilerState", "ProfilerTarget",
+    "make_scheduler", "export_chrome_tracing", "SummaryView",
+]

@@ -1,0 +1,223 @@
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Iterable, List, Optional
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    TPU = 1
+
+
+class _HostEventRecorder:
+    """Ring-buffer host span recorder (host_event_recorder.h analog)."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+        self.enabled = False
+
+    def record(self, name, start_us, end_us, tid):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append(
+                {"name": name, "ph": "X", "ts": start_us, "dur": end_us - start_us,
+                 "pid": os.getpid(), "tid": tid, "cat": "host"})
+
+    def drain(self):
+        with self._lock:
+            out = self.events
+            self.events = []
+        return out
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """Analog of paddle.profiler.RecordEvent (event_tracing.h RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter_ns() // 1000
+
+    def end(self):
+        if self._start is not None:
+            _recorder.record(self.name, self._start,
+                             time.perf_counter_ns() // 1000,
+                             threading.get_ident() % 100000)
+            self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Analog of paddle.profiler.make_scheduler."""
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        prof._export_path = path
+        prof.export(path)
+
+    return handler
+
+
+class Profiler:
+    """Analog of paddle.profiler.Profiler (profiler.py:344). Also starts a
+    jax.profiler trace (XPlane) when `timer_only=False` and a trace dir is
+    set via on_trace_ready=export_chrome_tracing(dir)."""
+
+    def __init__(self, *, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, record_shapes=False, profile_memory=False,
+                 timer_only=False, with_flops=False):
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._events: List[dict] = []
+        self._jax_trace_dir = None
+        self._jax_tracing = False
+        self._export_path = None
+        self._step_t0 = None
+        self._step_times = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        _recorder.enabled = True
+        self._state = (self._scheduler(self.step_num)
+                       if self._scheduler else ProfilerState.RECORD)
+        self._maybe_start_device_trace()
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        _recorder.enabled = False
+        self._events.extend(_recorder.drain())
+        self._maybe_stop_device_trace()
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_frames: int = 1):
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append(now - self._step_t0)
+        self._step_t0 = now
+        self.step_num += num_frames
+        if self._scheduler:
+            new_state = self._scheduler(self.step_num)
+            if new_state != self._state:
+                if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                    _recorder.enabled = True
+                elif self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+                    self._events.extend(_recorder.drain())
+                    _recorder.enabled = False
+                    if new_state == ProfilerState.CLOSED and self._on_trace_ready:
+                        self._on_trace_ready(self)
+                self._state = new_state
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- device trace ------------------------------------------------------
+    def _maybe_start_device_trace(self):
+        if self._timer_only:
+            return
+        try:
+            import jax
+
+            d = os.environ.get("PADDLE_TPU_TRACE_DIR")
+            if d:
+                jax.profiler.start_trace(d)
+                self._jax_tracing = True
+        except Exception:
+            pass
+
+    def _maybe_stop_device_trace(self):
+        if self._jax_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    # -- export / summary --------------------------------------------------
+    def export(self, path: str, format: str = "json"):
+        self._events.extend(_recorder.drain())
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._events}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        from paddle_tpu.ops.dispatch import OpStats
+
+        lines = ["---- profiler summary ----"]
+        if self._step_times:
+            import numpy as np
+
+            st = np.asarray(self._step_times[1:] or self._step_times)
+            lines.append(
+                f"steps={len(self._step_times)} mean={st.mean()*1e3:.3f}ms "
+                f"p50={np.percentile(st,50)*1e3:.3f}ms p99={np.percentile(st,99)*1e3:.3f}ms")
+        agg = {}
+        for e in self._events:
+            a = agg.setdefault(e["name"], [0, 0.0])
+            a[0] += 1
+            a[1] += e["dur"] / 1000.0
+        for name, (cnt, total) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:30]:
+            lines.append(f"{name:<40} calls={cnt:<8} total={total:.3f}ms")
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -1,0 +1,20 @@
+from __future__ import annotations
+
+import enum
+
+
+class SummaryView(enum.Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def benchmark():
+    """Analog of paddle.profiler.utils.benchmark timer hooks."""
+    return None

@@ -1,0 +1,5 @@
+"""paddle.vision analog (python/paddle/vision/). Models land in
+vision/models/; datasets/transforms follow."""
+from . import models, transforms
+
+__all__ = ["models", "transforms"]

@@ -1,0 +1,97 @@
+"""Image transforms — analog of python/paddle/vision/transforms/ (host-side
+numpy preprocessing; the device never sees un-batched images)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32)
+        if self.data_format == "CHW":
+            mean = self.mean.reshape(-1, 1, 1)
+            std = self.std.reshape(-1, 1, 1)
+        else:
+            mean, std = self.mean, self.std
+        return (x - mean) / std
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, x):
+        x = np.asarray(x, np.float32) / 255.0
+        if x.ndim == 2:
+            x = x[None]
+        elif x.ndim == 3 and self.data_format == "CHW":
+            x = x.transpose(2, 0, 1)
+        return x
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, x):
+        import jax.image
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(np.asarray(x, np.float32))
+        chw = arr.ndim == 3 and arr.shape[0] <= 4
+        if chw:
+            out = jax.image.resize(arr, (arr.shape[0],) + self.size, "linear")
+        elif arr.ndim == 3:
+            out = jax.image.resize(arr, self.size + (arr.shape[2],), "linear")
+        else:
+            out = jax.image.resize(arr, self.size, "linear")
+        return np.asarray(out)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(np.flip(np.asarray(x), axis=-1))
+        return x
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, x):
+        x = np.asarray(x)
+        chw = x.ndim == 3 and x.shape[0] <= 4
+        h_axis = 1 if chw else 0
+        if self.padding:
+            p = self.padding
+            cfg = [(0, 0)] * x.ndim
+            cfg[h_axis] = (p, p)
+            cfg[h_axis + 1] = (p, p)
+            x = np.pad(x, cfg)
+        H, W = x.shape[h_axis], x.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, H - th + 1)
+        j = np.random.randint(0, W - tw + 1)
+        if chw:
+            return x[:, i:i + th, j:j + tw]
+        return x[i:i + th, j:j + tw]

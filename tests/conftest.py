@@ -1,0 +1,31 @@
+"""Test harness: run everything on CPU with 8 virtual XLA devices so the
+multi-chip sharding paths compile and execute without TPU hardware —
+SURVEY §4 "multi-node testing without a cluster" TPU equivalent.
+Must run before jax initializes a backend.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# numerical-parity tests need exact fp32 matmuls; production keeps the
+# fast MXU default (bf16 passes) — this only affects the test process.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+
+    paddle_tpu.seed(42)
+    np.random.seed(42)
+    yield

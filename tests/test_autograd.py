@@ -1,0 +1,160 @@
+"""Autograd engine tests — analytic grads vs numpy/finite-difference, the
+OpTest check_grad pattern (unittests/op_test.py:2122 analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _leaf(data):
+    t = paddle.to_tensor(data)
+    t.stop_gradient = False
+    return t
+
+
+def test_simple_backward():
+    x = _leaf([2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = _leaf([1.0, 2.0])
+    y = paddle.exp(x * 2.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.exp([2.0, 4.0]), rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = _leaf([1.0])
+    y1 = (x * 2.0).sum()
+    y2 = (x * 3.0).sum()
+    y1.backward()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_stop_gradient():
+    x = _leaf([1.0, 2.0])
+    w = paddle.to_tensor([3.0, 4.0])  # stop_gradient=True
+    y = (x * w).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    assert w.grad is None
+
+
+def test_detach():
+    x = _leaf([2.0])
+    y = x * 3.0
+    z = y.detach() * x
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only via second factor
+
+
+def test_matmul_grad():
+    a = _leaf(np.random.randn(3, 4).astype(np.float32))
+    b = _leaf(np.random.randn(4, 2).astype(np.float32))
+    (a @ b).sum().backward()
+    ga = np.ones((3, 2)) @ b.numpy().T
+    gb = a.numpy().T @ np.ones((3, 2))
+    np.testing.assert_allclose(a.grad.numpy(), ga, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), gb, rtol=1e-5)
+
+
+def test_broadcast_grad():
+    x = _leaf(np.ones((3, 4), np.float32))
+    b = _leaf(np.ones((4,), np.float32))
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3, 3, 3, 3])
+
+
+def test_branching_graph():
+    x = _leaf([2.0])
+    a = x * 2.0
+    b = x * 3.0
+    y = (a + b).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_multi_output_op_grad():
+    x = _leaf(np.array([3.0, 1.0, 2.0], np.float32))
+    v, i = paddle.topk(x, 2)
+    v.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_softmax_ce_grad_matches_numeric():
+    logits = np.random.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4])
+    x = _leaf(logits)
+    loss = paddle.nn.functional.cross_entropy(x, paddle.to_tensor(labels))
+    loss.backward()
+    # numeric gradient
+    eps = 1e-3
+    g = np.zeros_like(logits)
+    import paddle_tpu.nn.functional as F
+
+    for i in range(logits.shape[0]):
+        for j in range(logits.shape[1]):
+            lp = logits.copy()
+            lp[i, j] += eps
+            lm = logits.copy()
+            lm[i, j] -= eps
+            fp = float(F.cross_entropy(paddle.to_tensor(lp), paddle.to_tensor(labels)).numpy())
+            fm = float(F.cross_entropy(paddle.to_tensor(lm), paddle.to_tensor(labels)).numpy())
+            g[i, j] = (fp - fm) / (2 * eps)
+    np.testing.assert_allclose(x.grad.numpy(), g, atol=1e-2)
+
+
+def test_no_grad():
+    x = _leaf([1.0])
+    with paddle.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+    assert y._creator is None
+
+
+def test_paddle_grad_api():
+    x = _leaf([2.0])
+    y = (x ** 3.0).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+    assert x.grad is None  # .grad slot untouched
+
+
+def test_backward_with_grad_tensor():
+    x = _leaf([1.0, 2.0])
+    y = x * 2.0
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_register_hook():
+    x = _leaf([1.0])
+    y = x * 2.0
+    seen = []
+    y.register_hook(lambda g: seen.append(g.numpy()) or g * 2.0)
+    y.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_retain_graph():
+    x = _leaf([2.0])
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_embedding_int_input_grad():
+    w = _leaf(np.random.randn(10, 4).astype(np.float32))
+    ids = paddle.to_tensor([1, 3, 1])
+    out = paddle.nn.functional.embedding(ids, w)
+    out.sum().backward()
+    expect = np.zeros((10, 4), np.float32)
+    expect[1] = 2.0
+    expect[3] = 1.0
+    np.testing.assert_allclose(w.grad.numpy(), expect)

@@ -1,0 +1,152 @@
+"""jit/to_static tests — eager vs compiled parity (the dy2static test
+pattern, unittests/dygraph_to_static/ analog)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_to_static_matches_eager():
+    @paddle.jit.to_static
+    def fn(x, y):
+        return paddle.tanh(x @ y) * 2.0
+
+    a, b = paddle.randn([3, 4]), paddle.randn([4, 5])
+    out = fn(a, b)
+    expect = np.tanh(a.numpy() @ b.numpy()) * 2
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4)
+
+
+def test_to_static_cache():
+    calls = []
+
+    @paddle.jit.to_static
+    def fn(x):
+        calls.append(1)
+        return x * 2.0
+
+    fn(paddle.randn([2, 2]))
+    fn(paddle.randn([2, 2]))  # same spec: no retrace
+    assert len(calls) == 1
+    fn(paddle.randn([3, 2]))  # new shape: retrace
+    assert len(calls) == 2
+    assert len(fn.concrete_programs) == 2
+
+
+def test_to_static_python_control_flow_static_branch():
+    @paddle.jit.to_static
+    def fn(x, flag):
+        if flag:  # static python value — baked per cache entry
+            return x + 1.0
+        return x - 1.0
+
+    x = paddle.zeros([2])
+    np.testing.assert_allclose(fn(x, True).numpy(), [1, 1])
+    np.testing.assert_allclose(fn(x, False).numpy(), [-1, -1])
+
+
+def test_to_static_layer_forward():
+    layer = nn.Linear(4, 2)
+    eager_out = layer(paddle.ones([1, 4]))
+    st = paddle.jit.to_static(layer)
+    out = st(paddle.ones([1, 4]))
+    np.testing.assert_allclose(out.numpy(), eager_out.numpy(), rtol=1e-5)
+
+
+def test_grad_inside_to_static():
+    """Whole fwd+bwd collapses into one XLA computation."""
+
+    @paddle.jit.to_static
+    def loss_and_grad(x, w):
+        w.stop_gradient = False
+        loss = ((x @ w) ** 2.0).sum()
+        (gw,) = paddle.grad(loss, w)
+        return loss, gw
+
+    x = paddle.randn([3, 4])
+    w = paddle.randn([4, 2])
+    loss, gw = loss_and_grad(x, w)
+    # reference grad: d/dw sum((xw)^2) = 2 x^T (xw)
+    expect = 2 * x.numpy().T @ (x.numpy() @ w.numpy())
+    np.testing.assert_allclose(gw.numpy(), expect, rtol=1e-4)
+
+
+def test_train_step_compiled():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, opt, lambda out, y: F.mse_loss(out, y))
+
+    x = paddle.randn([16, 4])
+    y = (x @ paddle.to_tensor([[1.0], [2.0], [-1.0], [0.5]]))
+    losses = [float(step(x, y)) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_train_step_matches_eager():
+    """Compiled TrainStep must produce the same params as eager loop."""
+
+    def build():
+        paddle.seed(3)
+        net = nn.Linear(3, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        return net, opt
+
+    x = paddle.randn([8, 3])
+    y = paddle.randn([8, 1])
+
+    net1, opt1 = build()
+    for _ in range(3):
+        loss = F.mse_loss(net1(x), y)
+        loss.backward()
+        opt1.step()
+        opt1.clear_grad()
+
+    net2, opt2 = build()
+    step = paddle.jit.TrainStep(net2, opt2, lambda o, t: F.mse_loss(o, t))
+    for _ in range(3):
+        step(x, y)
+
+    np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy(), rtol=1e-4)
+    np.testing.assert_allclose(net1.bias.numpy(), net2.bias.numpy(), rtol=1e-4)
+
+
+def test_jit_save_load(tmp_path):
+    layer = nn.Linear(4, 2)
+    path = str(tmp_path / "model")
+    paddle.jit.save(layer, path)
+    loaded = paddle.jit.load(path)
+    fresh = nn.Linear(4, 2)
+    loaded.load_into(fresh)
+    np.testing.assert_allclose(fresh.weight.numpy(), layer.weight.numpy())
+
+
+def test_paddle_save_load(tmp_path):
+    net = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 2))
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    p = net.parameters()[0]
+    p.grad = paddle.ones_like(p)
+    opt.step()
+
+    paddle.save(net.state_dict(), str(tmp_path / "model.pdparams"))
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+
+    net2 = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 2))
+    net2.set_state_dict(paddle.load(str(tmp_path / "model.pdparams")))
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=net2.parameters())
+    opt2.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+
+    np.testing.assert_allclose(
+        net2.parameters()[0].numpy(), net.parameters()[0].numpy())
+    assert opt2._step_count == 1
+
+
+def test_bf16_save_load_roundtrip(tmp_path):
+    t = paddle.to_tensor([1.5, 2.5], dtype="bfloat16")
+    paddle.save({"w": t}, str(tmp_path / "bf16.pd"))
+    loaded = paddle.load(str(tmp_path / "bf16.pd"))
+    assert loaded["w"].dtype == "bfloat16"
+    np.testing.assert_allclose(
+        loaded["w"].astype("float32").numpy(), [1.5, 2.5])

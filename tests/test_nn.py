@@ -1,0 +1,213 @@
+"""nn layer tests vs numpy references (OpTest pattern, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    expect = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), expect, rtol=1e-5)
+
+
+def test_linear_no_bias():
+    layer = nn.Linear(4, 3, bias_attr=False)
+    assert layer.bias is None
+    y = layer(paddle.randn([2, 4]))
+    assert y.shape == [2, 3]
+
+
+def test_layer_registration():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    m = M()
+    params = m.parameters()
+    assert len(params) == 4
+    names = [n for n, _ in m.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    y = m(paddle.randn([3, 4]))
+    assert y.shape == [3, 2]
+
+
+def test_state_dict_roundtrip():
+    m = nn.Linear(3, 3)
+    sd = m.state_dict()
+    m2 = nn.Linear(3, 3)
+    m2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    np.testing.assert_allclose(m2.weight.numpy(), m.weight.numpy())
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    # stride 2
+    conv2 = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    assert conv2(x).shape == [2, 8, 8, 8]
+
+
+def test_conv2d_matches_numpy():
+    # 1x1 conv == matmul over channels
+    conv = nn.Conv2D(3, 5, 1, bias_attr=False)
+    x = paddle.randn([1, 3, 4, 4])
+    y = conv(x).numpy()
+    w = conv.weight.numpy().reshape(5, 3)
+    expect = np.einsum("oc,nchw->nohw", w, x.numpy())
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5])
+    bn.train()
+    y = bn(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=(0, 2, 3)), 1.0, atol=1e-2)
+    # running stats moved
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 3, 8])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    ids = paddle.to_tensor([[1, 2], [3, 4]])
+    y = emb(ids)
+    assert y.shape == [2, 2, 4]
+    np.testing.assert_allclose(y.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_dropout():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    drop.train()
+    y = drop(x)
+    kept = (y.numpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    np.testing.assert_allclose(y.numpy()[y.numpy() != 0], 2.0)
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, -0.5, 0.0, 0.5, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    np.testing.assert_allclose(F.tanh(x).numpy(), np.tanh(x.numpy()), rtol=1e-4)
+    s = F.softmax(x).numpy()
+    np.testing.assert_allclose(s.sum(), 1.0, rtol=1e-5)
+    assert F.gelu(x).shape == [5]
+    assert F.leaky_relu(x).numpy()[0] == pytest.approx(-0.02)
+
+
+def test_losses():
+    logits = paddle.randn([4, 10])
+    labels = paddle.to_tensor([1, 2, 3, 4])
+    loss = F.cross_entropy(logits, labels)
+    assert loss.shape == []
+    # manual CE
+    lg = logits.numpy()
+    p = np.exp(lg) / np.exp(lg).sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), labels.numpy()]).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+
+    a, b = paddle.randn([3, 2]), paddle.randn([3, 2])
+    np.testing.assert_allclose(
+        float(F.mse_loss(a, b)), ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(F.l1_loss(a, b)), np.abs(a.numpy() - b.numpy()).mean(), rtol=1e-5)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp_ = nn.MaxPool2D(2, 2)(x)
+    np.testing.assert_allclose(mp_.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2, 2)(x)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    gap = nn.AdaptiveAvgPool2D(1)(x)
+    np.testing.assert_allclose(gap.numpy()[0, 0, 0, 0], 7.5)
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = seq(paddle.randn([2, 4]))
+    assert y.shape == [2, 2]
+    assert len(seq) == 3
+
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(nn.Sequential(*ll).parameters()) == 8
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    y = mha(x)
+    assert y.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    y = enc(x)
+    assert y.shape == [2, 6, 16]
+    # layers are independent copies
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p = paddle.ones([4])
+    g = paddle.to_tensor([10.0, 0.0, 0.0, 0.0])
+    from paddle_tpu.core.tensor import Tensor
+
+    out = clip([(p, g)])
+    np.testing.assert_allclose(np.linalg.norm(out[0][1].numpy()), 1.0, rtol=1e-5)
+
+
+def test_train_loop_converges():
+    """End-to-end: tiny regression must reduce loss (the dist-test loss
+    parity pattern, test_dist_base.py analog for single device)."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    x = paddle.randn([32, 4])
+    w_true = paddle.to_tensor([[1.0], [-2.0], [0.5], [3.0]])
+    y_true = x @ w_true
+
+    losses = []
+    for _ in range(50):
+        loss = F.mse_loss(net(x), y_true)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]

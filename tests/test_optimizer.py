@@ -1,0 +1,129 @@
+"""Optimizer tests: update rules vs hand-computed references + state dict."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _param(v):
+    from paddle_tpu.core.tensor import Parameter
+
+    return Parameter(np.asarray(v, np.float32))
+
+
+def test_sgd_matches_formula():
+    p = _param([1.0, 2.0])
+    p.grad = paddle.to_tensor([0.5, 0.5])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.95, 1.95], rtol=1e-6)
+
+
+def test_momentum():
+    p = _param([1.0])
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    p.grad = paddle.to_tensor([1.0])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+    p.grad = paddle.to_tensor([1.0])
+    opt.step()
+    # velocity = 0.9*1 + 1 = 1.9 ; p = 0.9 - 0.1*1.9
+    np.testing.assert_allclose(p.numpy(), [0.71], rtol=1e-6)
+
+
+def test_adam_matches_reference():
+    p = _param([1.0])
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    m = v = 0.0
+    val = 1.0
+    for t in range(1, 4):
+        g = val * 2  # pretend grad = 2*p
+        p.grad = paddle.to_tensor([g])
+        opt.step()
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mhat = m / (1 - 0.9 ** t)
+        vhat = v / (1 - 0.999 ** t)
+        val = val - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), [val], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = _param([1.0])
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+    p.grad = paddle.to_tensor([0.0])
+    opt.step()
+    # grad=0 -> only decay term: p - lr*wd*p = 1 - 0.1*0.5
+    np.testing.assert_allclose(p.numpy(), [0.95], rtol=1e-5)
+
+
+def test_clear_grad_and_none_grads():
+    p1, p2 = _param([1.0]), _param([2.0])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p1, p2])
+    p1.grad = paddle.to_tensor([1.0])
+    opt.step()  # p2 has no grad: untouched
+    np.testing.assert_allclose(p2.numpy(), [2.0])
+    opt.clear_grad()
+    assert p1.grad is None
+
+
+def test_lr_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = _param([1.0])
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for _ in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+
+def test_warmup_scheduler():
+    sched = paddle.optimizer.lr.LinearWarmup(
+        learning_rate=0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(6):
+        vals.append(sched())
+        sched.step()
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075])
+    np.testing.assert_allclose(vals[4:], [0.1, 0.1])
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = _param([1.0, 2.0])
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    p.grad = paddle.to_tensor([0.1, 0.2])
+    opt.step()
+    sd = opt.state_dict()
+
+    p2 = _param([1.0, 2.0])
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(opt2._accumulators["moment1"][0]),
+        np.asarray(opt._accumulators["moment1"][0]))
+
+
+def test_grad_clip_in_optimizer():
+    p = _param([1.0])
+    opt = paddle.optimizer.SGD(
+        learning_rate=1.0, parameters=[p],
+        grad_clip=nn.ClipGradByGlobalNorm(0.1))
+    p.grad = paddle.to_tensor([100.0])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-4)
+
+
+def test_minimize():
+    x = paddle.to_tensor([3.0])
+    x.stop_gradient = False
+    from paddle_tpu.core.tensor import Parameter
+
+    p = _param([3.0])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * p).sum()
+    opt.minimize(loss)
+    np.testing.assert_allclose(p.numpy(), [3.0 - 0.1 * 6.0], rtol=1e-5)
+    assert p.grad is None

@@ -1,0 +1,111 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_cross_entropy_ignore_index_default():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([1, -100, 2, -100])
+    loss = F.cross_entropy(logits, labels)
+    # reference: mean over the 2 valid positions only
+    lg = logits.numpy()
+    p = np.exp(lg) / np.exp(lg).sum(-1, keepdims=True)
+    expect = -np.log(p[[0, 2], [1, 2]]).mean()
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-4)
+    # all-ignored must not NaN
+    loss2 = F.cross_entropy(logits, paddle.to_tensor([-100] * 4))
+    assert np.isfinite(float(loss2))
+
+
+def test_cross_entropy_ignore_index_grad_zero_at_ignored():
+    logits = paddle.randn([3, 4])
+    logits.stop_gradient = False
+    labels = paddle.to_tensor([0, -100, 1])
+    F.cross_entropy(logits, labels).backward()
+    g = logits.grad.numpy()
+    np.testing.assert_allclose(g[1], 0.0, atol=1e-7)
+    assert np.abs(g[0]).sum() > 0
+
+
+def test_adamw_decay_param_fun():
+    from paddle_tpu.core.tensor import Parameter
+
+    w = Parameter(np.ones(2, np.float32), name="layer.weight")
+    b = Parameter(np.ones(2, np.float32), name="layer.bias")
+    opt = paddle.optimizer.AdamW(
+        learning_rate=0.1, weight_decay=0.5, parameters=[w, b],
+        apply_decay_param_fun=lambda n: "bias" not in n)
+    w.grad = paddle.zeros([2])
+    b.grad = paddle.zeros([2])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.95, 0.95], rtol=1e-5)  # decayed
+    np.testing.assert_allclose(b.numpy(), [1.0, 1.0], rtol=1e-6)  # not decayed
+
+
+def test_grad_api_does_not_pollute_other_leaves():
+    from paddle_tpu.core.tensor import Parameter
+
+    w = Parameter(np.array([2.0], np.float32))
+    x = paddle.to_tensor([3.0])
+    x.stop_gradient = False
+    loss = (w * x).sum()
+    (gx,) = paddle.grad(loss, [x], retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+    assert w.grad is None  # must not be polluted
+    assert x.grad is None
+
+
+def test_grad_scaler_unscale_then_step():
+    from paddle_tpu.core.tensor import Parameter
+
+    p = Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    loss = (p * 2.0).sum()  # dL/dp = 2
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.unscale_(opt)  # user unscales manually (e.g. to clip)
+    np.testing.assert_allclose(p.grad.numpy(), [2.0], rtol=1e-6)
+    scaler.step(opt)  # must NOT unscale a second time
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [-1.0], rtol=1e-6)
+
+
+def test_split_non_divisible_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        paddle.split(paddle.ones([10, 2]), 3, axis=0)
+
+
+def test_batch_norm_bias_only_training():
+    x = paddle.randn([8, 3, 4, 4])
+    rm, rv = paddle.zeros([3]), paddle.ones([3])
+    b = paddle.to_tensor([1.0, 2.0, 3.0])
+    out = F.batch_norm(x, rm, rv, weight=None, bias=b, training=True)
+    means = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(means, [1.0, 2.0, 3.0], atol=1e-4)
+
+
+def test_nll_loss_4d():
+    n, c, h, w = 2, 5, 3, 3
+    logp = F.log_softmax(paddle.randn([n, c, h, w]), axis=1)
+    target = paddle.randint(0, c, [n, h, w])
+    loss = F.nll_loss(logp, target)
+    lp = logp.numpy()
+    t = target.numpy()
+    ref = -np.take_along_axis(lp, t[:, None], axis=1)[:, 0].mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_interpolate_align_corners():
+    x = paddle.to_tensor(
+        np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = F.interpolate(x, size=(3, 3), mode="bilinear", align_corners=True)
+    # corners preserved exactly under align_corners=True
+    o = out.numpy()[0, 0]
+    np.testing.assert_allclose(
+        [o[0, 0], o[0, 2], o[2, 0], o[2, 2]], [0, 1, 2, 3], atol=1e-5)
+    np.testing.assert_allclose(o[1, 1], 1.5, atol=1e-5)

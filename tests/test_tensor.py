@@ -1,0 +1,160 @@
+"""Tensor basics: creation, dtype, arithmetic, indexing — the analog of
+the reference's eager tensor unit tests (test_egr_python_api etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    # TPU-native: ints are 32-bit natively; "int64" is an accepted alias
+    assert paddle.to_tensor(1).dtype == "int32"
+    assert paddle.to_tensor(1, dtype="int64").dtype == "int32"
+    assert paddle.to_tensor(1.5).dtype == "float32"
+    assert paddle.to_tensor(True).dtype == "bool"
+    assert paddle.to_tensor(np.float64(2.0)).dtype == "float32"
+    assert paddle.to_tensor([1, 2], dtype="bfloat16").dtype == "bfloat16"
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([4]).numpy().sum() == 4
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.full([2, 2], 7.0).numpy().sum() == 28
+    assert paddle.eye(3).numpy().trace() == 3
+    assert paddle.linspace(0, 1, 5).shape == [5]
+
+
+def test_arithmetic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9], rtol=1e-5)
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose((2.0 * a).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((1.0 + a).numpy(), [2, 3, 4])
+
+
+def test_scalar_keeps_dtype():
+    a = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert (a * 2.0).dtype == "bfloat16"
+    assert (a + 1).dtype == "bfloat16"
+
+
+def test_comparisons():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal((a > 1.5).numpy(), [False, True, True])
+    np.testing.assert_array_equal((a == 2.0).numpy(), [False, True, False])
+
+
+def test_matmul():
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    c = a @ b
+    assert c.shape == [3, 5]
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+
+
+def test_indexing():
+    a = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+    np.testing.assert_allclose(a[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(a[1, 2].numpy(), 6)
+    np.testing.assert_allclose(a[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(a[0:2, 1:3].numpy(), [[1, 2], [5, 6]])
+
+
+def test_setitem():
+    a = paddle.zeros([3, 3])
+    a[1, 1] = 5.0
+    assert a.numpy()[1, 1] == 5.0
+
+
+def test_reshape_transpose():
+    a = paddle.arange(6, dtype="float32")
+    b = a.reshape([2, 3])
+    assert b.shape == [2, 3]
+    c = b.transpose([1, 0])
+    assert c.shape == [3, 2]
+    np.testing.assert_allclose(c.numpy(), b.numpy().T)
+    assert b.T.shape == [3, 2]
+
+
+def test_reductions():
+    a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert float(a.sum()) == 10
+    assert float(a.mean()) == 2.5
+    assert float(a.max()) == 4
+    np.testing.assert_allclose(a.sum(axis=0).numpy(), [4, 6])
+    np.testing.assert_allclose(a.sum(axis=1, keepdim=True).numpy(), [[3], [7]])
+    assert a.argmax().numpy() == 3
+
+
+def test_concat_split_stack():
+    a = paddle.ones([2, 3])
+    b = paddle.zeros([2, 3])
+    c = paddle.concat([a, b], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([a, b], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+    np.testing.assert_allclose(parts[0].numpy(), a.numpy())
+
+
+def test_cast():
+    a = paddle.to_tensor([1.5, 2.5])
+    assert a.astype("int32").dtype == "int32"
+    assert a.astype("bfloat16").dtype == "bfloat16"
+
+
+def test_where_clip():
+    a = paddle.to_tensor([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(a.clip(0.0, 1.0).numpy(), [0, 0.5, 1.0])
+    w = paddle.where(a > 0, a, paddle.zeros_like(a))
+    np.testing.assert_allclose(w.numpy(), [0, 0.5, 2.0])
+
+
+def test_item_and_bool():
+    a = paddle.to_tensor([3.0])
+    assert a.item() == 3.0
+    assert bool(a > 2.0)
+    with pytest.raises(ValueError):
+        bool(paddle.ones([2]) > 0)
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    idx = paddle.to_tensor([0, 2])
+    g = paddle.gather(x, idx)
+    np.testing.assert_allclose(g.numpy(), [[1, 2], [5, 6]])
+    upd = paddle.to_tensor([[9.0, 9.0], [8.0, 8.0]])
+    s = paddle.scatter(x, idx, upd)
+    np.testing.assert_allclose(s.numpy(), [[9, 9], [3, 4], [8, 8]])
+
+
+def test_topk_sort():
+    a = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+    v, i = paddle.topk(a, 2)
+    np.testing.assert_allclose(v.numpy(), [5, 4])
+    s = paddle.sort(a, descending=True)
+    np.testing.assert_allclose(s.numpy(), [5, 4, 3, 1, 1])
+
+
+def test_random_deterministic():
+    import paddle_tpu
+
+    paddle_tpu.seed(7)
+    a = paddle.randn([4])
+    paddle_tpu.seed(7)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())

@@ -1,0 +1,87 @@
+"""Benchmark: GPT training throughput on one chip, bf16, fully-compiled
+TrainStep (fwd+bwd+AdamW in a single donated XLA program).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline is achieved MFU / 0.45 (the BASELINE.md target MFU).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as jit
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024, dropout=0.0)
+        batch = int(os.environ.get("BENCH_BATCH", "8"))
+        steps = int(os.environ.get("BENCH_STEPS", "20"))
+        peak_flops = 197e12  # v5e bf16 peak per chip
+    else:  # CPU smoke mode
+        cfg = GPTConfig.tiny(vocab=512, hidden=128, layers=2, heads=4, seq=128)
+        batch, steps = 2, 5
+        peak_flops = 1e12
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()  # no dropout inside compiled step
+    model.to(dtype="bfloat16")  # MXU-native; optimizer keeps fp32 master state
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = jit.TrainStep(model, opt, model.loss_fn)
+
+    seq = cfg.max_seq_len
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, seq), np.int32))
+
+    # multi-step: the whole timed region is ONE XLA program (lax.scan over
+    # steps) so per-dispatch latency doesn't pollute the measurement
+    ids_stack = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (steps, batch, seq), np.int32))
+
+    t0 = time.time()
+    losses = step.run_scan(ids_stack, ids_stack)  # compile + first run
+    losses._array.block_until_ready()
+    compile_s = time.time() - t0
+
+    t1 = time.time()
+    losses = step.run_scan(ids_stack, ids_stack)
+    losses._array.block_until_ready()
+    dt = time.time() - t1
+    loss = losses[-1]
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+    # training FLOPs/token: 6N (fwd+bwd params) + attention term
+    n_params = model.num_params()
+    flops_tok = model.flops_per_token(seq)
+    mfu = tok_s * flops_tok / peak_flops
+
+    result = {
+        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }
+    print(json.dumps(result))
+    print(f"# backend={backend} params={n_params/1e6:.1f}M batch={batch} "
+          f"seq={seq} steps={steps} compile={compile_s:.1f}s "
+          f"step={dt/steps*1000:.1f}ms mfu={mfu:.3f} loss={float(loss):.3f}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,7 @@ class Tensor:
         "_out_idx",
         "name",
         "persistable",
+        "dist_spec",  # PartitionSpec annotation consumed by spmd.TrainStep
         "__weakref__",
     )
 
@@ -59,6 +60,7 @@ class Tensor:
         self._out_idx = 0
         self.name = name
         self.persistable = False
+        self.dist_spec = None
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -71,6 +73,7 @@ class Tensor:
         t._out_idx = out_idx
         t.name = ""
         t.persistable = False
+        t.dist_spec = None
         return t
 
     # -- metadata ----------------------------------------------------------

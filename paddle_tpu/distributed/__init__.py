@@ -7,7 +7,19 @@ paddle-parity eager API is kept as a thin façade.
 """
 from jax.sharding import PartitionSpec
 
-from . import functional
+from . import fleet, functional, moe, mp_layers, ring_attention, sharding
+from .mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .moe import MoELayer
+from .recompute import recompute
+from .ring_attention import ring_attention, ulysses_attention
+from .sharding import group_sharded_parallel, save_group_sharded_model
+from .spmd import DistributedTrainStep
 from .collective import (
     Group,
     ReduceOp,

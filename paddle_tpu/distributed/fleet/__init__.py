@@ -1,0 +1,84 @@
+"""Fleet facade — analog of python/paddle/distributed/fleet/fleet.py:169
+(init), model.py:30 (distributed_model), optimizer.py:65
+(distributed_optimizer) and base/distributed_strategy.py (2556 LoC).
+
+On TPU the facade configures ONE mesh (HybridCommunicateGroup) from the
+strategy's hybrid_configs and returns wrappers whose collectives live in
+the compiled SPMD step (spmd.DistributedTrainStep) rather than in NCCL
+process groups.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .distributed_strategy import DistributedStrategy
+from .. import mp_layers as _mpu
+from ..mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from ..recompute import recompute
+
+_fleet_state = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStrategy] = None):
+    """Analog of fleet.init (fleet.py:169): builds the hybrid topology
+    from strategy.hybrid_configs and installs the global mesh."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp=hc.get("dp_degree", 1),
+        mp=hc.get("mp_degree", 1),
+        pp=hc.get("pp_degree", 1),
+        sharding=hc.get("sharding_degree", 1),
+        cp=hc.get("cp_degree", 1),
+        ep=hc.get("ep_degree", 1),
+    )
+    set_hybrid_communicate_group(hcg)
+    _fleet_state["initialized"] = True
+    _fleet_state["strategy"] = strategy
+    return hcg
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group_():
+    return get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    """Analog of fleet.distributed_model (model.py:30). Under SPMD there
+    is nothing to wrap for dp/mp/sharding — shardings are annotations and
+    the collectives compile into the step — so the model is returned
+    as-is; pipeline wrapping (PipelineLayer) is explicit, as in the
+    reference."""
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Analog of fleet.distributed_optimizer (optimizer.py:65): returns
+    the optimizer unchanged — grad synchronization is part of the
+    compiled SPMD step (see spmd.DistributedTrainStep), which subsumes
+    HybridParallelOptimizer's fused_allreduce_gradients."""
+    return optimizer
+
+
+def get_strategy():
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+# re-exports for parity with fleet.meta_parallel / fleet.layers.mpu
+meta_parallel = _mpu
+layers = _mpu

@@ -1,0 +1,86 @@
+"""DistributedStrategy — analog of
+python/paddle/distributed/fleet/base/distributed_strategy.py (protobuf-
+backed, hybrid_configs at :1651). Plain-dict config here; serializable
+via to_dict/from_dict (the proto is an implementation detail we drop).
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+
+_DEFAULTS = {
+    "amp": False,
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "use_pure_bf16": True,
+        "custom_white_list": [],
+        "custom_black_list": [],
+    },
+    "recompute": False,
+    "recompute_configs": {"checkpoints": []},
+    "sharding": False,
+    "sharding_configs": {"stage": 1, "degree": 1, "offload": False},
+    "pipeline": False,
+    "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1,
+                         "schedule_mode": "1F1B"},
+    "hybrid_configs": {
+        "dp_degree": 1,
+        "mp_degree": 1,
+        "pp_degree": 1,
+        "sharding_degree": 1,
+        "cp_degree": 1,
+        "ep_degree": 1,
+    },
+    "gradient_merge": False,
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "lamb": False,
+    "localsgd": False,
+    "dgc": False,
+    "gradient_scale_configs": {"scale_strategy": "avg"},
+    "find_unused_parameters": False,
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._conf = copy.deepcopy(_DEFAULTS)
+
+    def __getattr__(self, name):
+        conf = object.__getattribute__(self, "_conf")
+        if name in conf:
+            return conf[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if name == "_conf":
+            object.__setattr__(self, name, value)
+            return
+        if name in self._conf:
+            if name.endswith("_configs") and isinstance(value, dict):
+                self._conf[name].update(value)
+            else:
+                self._conf[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def to_dict(self):
+        return copy.deepcopy(self._conf)
+
+    def from_dict(self, d):
+        for k, v in d.items():
+            setattr(self, k, v)
+        return self
+
+    def save_to_prototxt(self, path):  # reference-API name; JSON payload
+        with open(path, "w") as f:
+            json.dump(self._conf, f, indent=2)
+
+    def load_from_prototxt(self, path):
+        with open(path) as f:
+            self.from_dict(json.load(f))
+
+    def __repr__(self):
+        return f"DistributedStrategy({json.dumps(self._conf, indent=1)})"

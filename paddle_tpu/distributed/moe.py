@@ -1,0 +1,162 @@
+"""Mixture-of-Experts with expert parallelism — analog of
+python/paddle/incubate/distributed/models/moe/moe_layer.py:260 (MoELayer)
+with gates (gate/gshard_gate.py, switch_gate.py, naive_gate.py), capacity
+limiting (utils.py limit_by_capacity) and the global_scatter/global_gather
+all-to-all dispatch ops (operators/collective/global_scatter_op.cu.cc).
+
+TPU-native design: token dispatch is dense one-hot einsum routing into a
+[experts, capacity, d] buffer (the GShard/Switch formulation XLA loves —
+static shapes, MXU-friendly), and the cross-device exchange over the 'ep'
+axis is lax.all_to_all inside the SPMD program instead of NCCL alltoall
+kernels. With ep degree 1 everything stays local and the layer is a dense
+jax computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply
+
+from .topology import get_hybrid_communicate_group
+
+
+def top2_gating(logits, capacity, second_policy_train="random", key=None):
+    """GShard top-2 gating (gate/gshard_gate.py analog): returns
+    combine_weights [T, E, C] and dispatch_mask [T, E, C] plus aux loss.
+    Pure jax; T=tokens, E=experts, C=capacity."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)  # [T]
+    g1 = jnp.take_along_axis(probs, g1_idx[:, None], axis=-1)[:, 0]
+    probs_wo1 = probs * (1 - jax.nn.one_hot(g1_idx, E))
+    g2_idx = jnp.argmax(probs_wo1, axis=-1)
+    g2 = jnp.take_along_axis(probs_wo1, g2_idx[:, None], axis=-1)[:, 0]
+
+    # aux load-balance loss (GShard eq.4): mean_prob * fraction_routed
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(g1_idx, E).mean(axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    # position within each expert queue via cumsum over one-hot
+    mask1 = jax.nn.one_hot(g1_idx, E)
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1) * mask1  # [T,E]
+    mask2 = jax.nn.one_hot(g2_idx, E)
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1 + mask1.sum(0)[None, :]) * mask2
+
+    keep1 = (pos1 < capacity) & (mask1 > 0)
+    keep2 = (pos2 < capacity) & (mask2 > 0)
+
+    loc1 = pos1.sum(axis=-1).astype(jnp.int32)  # slot for primary expert
+    loc2 = pos2.sum(axis=-1).astype(jnp.int32)
+
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    w1 = g1 / denom
+    w2 = g2 / denom
+
+    cap_oh1 = jax.nn.one_hot(loc1, capacity) * keep1.max(-1, keepdims=True)
+    cap_oh2 = jax.nn.one_hot(loc2, capacity) * keep2.max(-1, keepdims=True)
+    combine = (w1[:, None, None] * mask1[:, :, None] * cap_oh1[:, None, :]
+               + w2[:, None, None] * mask2[:, :, None] * cap_oh2[:, None, :])
+    dispatch = combine > 0
+    return combine.astype(logits.dtype), dispatch, aux_loss
+
+
+def switch_gating(logits, capacity):
+    """Switch-transformer top-1 gating (switch_gate.py analog)."""
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    me = probs.mean(axis=0)
+    ce = jax.nn.one_hot(idx, E).mean(axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+    mask = jax.nn.one_hot(idx, E)
+    pos = (jnp.cumsum(mask, axis=0) - 1) * mask
+    keep = (pos < capacity) & (mask > 0)
+    loc = pos.sum(axis=-1).astype(jnp.int32)
+    cap_oh = jax.nn.one_hot(loc, capacity) * keep.max(-1, keepdims=True)
+    combine = gate[:, None, None] * mask[:, :, None] * cap_oh[:, None, :]
+    return combine.astype(logits.dtype), combine > 0, aux_loss
+
+
+class ExpertFFN(nn.Layer):
+    """One expert MLP; MoELayer stacks E of these into batched weights."""
+
+    def __init__(self, d_model, d_hidden):
+        super().__init__()
+        self.fc1 = nn.Linear(d_model, d_hidden)
+        self.fc2 = nn.Linear(d_hidden, d_model)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+class MoELayer(nn.Layer):
+    """Analog of incubate MoELayer (moe_layer.py:260).
+
+    Experts are stored BATCHED: w1 [E, d, h], w2 [E, h, d] — one einsum
+    runs all local experts on the MXU; the 'ep' mesh axis shards the E
+    dim (dist_spec), so XLA partitions expert compute and inserts the
+    all-to-all for token exchange.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 capacity_factor=1.25, ep_group=None, name=None):
+        super().__init__()
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu.nn import initializer as I
+
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.gate_type = gate
+        self.gate_proj = nn.Linear(d_model, num_experts, bias_attr=False)
+        init = I.XavierUniform()
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=init)
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        ep = get_hybrid_communicate_group().axis_size("ep")
+        if ep > 1:
+            assert num_experts % ep == 0
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                p.dist_spec = P("ep")
+        self.aux_loss = None
+
+    def forward(self, x):
+        B, S, D = x.shape
+        E = self.num_experts
+        cap = int(self.capacity_factor * B * S / E) or 1
+        gate_t = self.gate_proj(x)  # [B,S,E] tracked op
+
+        def fn(xa, ga, w1, b1, w2, b2):
+            T = B * S
+            xt = xa.reshape(T, D)
+            gt = ga.reshape(T, E)
+            if self.gate_type == "switch":
+                combine, dispatch, aux = switch_gating(gt, cap)
+            else:
+                combine, dispatch, aux = top2_gating(gt, cap)
+            # dispatch: [T,E,C] one-hot -> expert buffers [E,C,D]
+            buf = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)
+            h = jnp.einsum("ecd,edh->ech", buf, w1) + b1
+            h = jax.nn.gelu(h)
+            out = jnp.einsum("ech,ehd->ecd", h, w2) + b2
+            # combine back: weighted gather [T,E,C] x [E,C,D] -> [T,D]
+            y = jnp.einsum("tec,ecd->td", combine, out)
+            return y.reshape(B, S, D), aux
+
+        out, aux = apply("moe", fn, x, gate_t, self.w1, self.b1, self.w2,
+                         self.b2)
+        self.aux_loss = aux
+        return out

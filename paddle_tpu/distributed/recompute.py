@@ -1,0 +1,73 @@
+"""Activation recomputation — analog of
+python/paddle/distributed/fleet/recompute/recompute.py (RecomputeFunction
+PyLayer :69, _recompute_without_reentrant :220).
+
+TPU-native: the segment is wrapped in jax.checkpoint (remat) and run
+through jax.vjp. The VJP closure then stores ONLY the segment inputs;
+the forward is re-run inside the backward pass — identical memory/compute
+trade to the reference, but the recompute happens inside the compiled XLA
+program (fused, on-chip) rather than as a Python re-execution. RNG state
+capture/restore (the swith_rng_state_tracker dance, recompute.py:57) is
+unnecessary: jax PRNG keys are values, so the replay is deterministic by
+construction.
+"""
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.autograd import Node, is_grad_enabled
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import OpStats
+
+
+def recompute(function, *args, use_reentrant=True, **kwargs):
+    """fleet.utils.recompute analog. `function` may be a Layer or any
+    callable over Tensors; its parameters participate in autodiff."""
+    import jax.numpy as jnp
+
+    layer_params = list(function.parameters()) if hasattr(function, "parameters") else []
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    diff_inputs = tensor_args + [p for p in layer_params if not p.stop_gradient]
+
+    def pure(*arrays):
+        n_args = len(tensor_args)
+        originals = [p._array for p in diff_inputs[n_args:]]
+        it = iter(arrays[:n_args])
+        new_args = [
+            Tensor._wrap(next(it), stop_gradient=a.stop_gradient)
+            if isinstance(a, Tensor) else a
+            for a in args
+        ]
+        try:
+            for p, arr in zip(diff_inputs[n_args:], arrays[n_args:]):
+                p._array = arr
+            out = function(*new_args, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t._array if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+        finally:
+            for p, o in zip(diff_inputs[n_args:], originals):
+                p._array = o
+
+    arrays = [t._array for t in diff_inputs]
+    needs_grad = is_grad_enabled() and any(
+        not t.stop_gradient for t in diff_inputs)
+    OpStats.record("recompute")
+    if not needs_grad:
+        out = pure(*arrays)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+        wrapped = [Tensor._wrap(o) for o in outs]
+        return wrapped[0] if single else tuple(wrapped)
+
+    ckpt = jax.checkpoint(pure)
+    out, vjp_fn = jax.vjp(ckpt, *arrays)
+    single = not isinstance(out, (tuple, list))
+    outs = [out] if single else list(out)
+    specs = [(o.shape, o.dtype) for o in outs]
+    node = Node("recompute", vjp_fn, diff_inputs, specs)
+    wrapped = [
+        Tensor._wrap(o, stop_gradient=False, creator=node, out_idx=i)
+        for i, o in enumerate(outs)
+    ]
+    return wrapped[0] if single else tuple(wrapped)

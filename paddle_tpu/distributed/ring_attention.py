@@ -1,0 +1,147 @@
+"""Context/sequence parallelism: ring attention + Ulysses (all-to-all)
+attention over the 'cp' mesh axis.
+
+The reference has NO sequence parallelism (SURVEY §2.5: absent in v2.4 —
+it scales long sequences only via recompute + TP/PP memory splitting).
+This module supplies the capability TPU-natively:
+
+- ring_attention: K/V blocks rotate around the 'cp' ring via
+  lax.ppermute (ICI neighbor exchange) while each device keeps its Q
+  shard; softmax is accumulated online (flash-attention style running
+  max/denominator), so the full S×S score matrix never materializes.
+  Compute/communication overlap is XLA's job (the ppermute for step i+1
+  can overlap the matmul of step i).
+- ulysses_attention: all-to-all swaps the sequence shard for a head
+  shard (seq-parallel -> head-parallel), runs dense local attention,
+  and swaps back — cheaper than ring when heads % cp == 0 and sequence
+  lengths are moderate.
+
+Both are pure jax functions over raw arrays intended for use inside
+shard_map with axis 'cp' (or any named axis passed in).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One block: returns (unnormalized out, running max, denom).
+    q:[B,H,Sq,D] k,v:[B,H,Sk,D] mask:[Sq,Sk] or None."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        # fully-masked rows would otherwise contribute exp(0)=1 per entry
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
+                   scale=None):
+    """Blockwise ring attention inside shard_map.
+
+    Args are LOCAL shards [B, S_local, H, D] (paddle layout); returns the
+    local output shard [B, S_local, H, D]. The global sequence is the
+    concatenation over the 'cp' axis in axis-index order.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    # [B,H,S,D] layout for the MXU
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+
+    q_pos = my * S + jnp.arange(S)  # global positions of my queries
+
+    shift = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(i, carry):
+        o, m, l, kc, vc = carry
+        # kc currently holds the block originally owned by (my - i) mod n
+        src = (my - i) % n
+        k_pos = src * S + jnp.arange(S)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        bo, bm, bl = _block_attn(qh, kc, vc, scale, mask)
+        # online softmax merge — accumulator stays fp32 regardless of the
+        # input dtype (bf16 inputs would otherwise change the carry type)
+        new_m = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - new_m)
+        beta = jnp.exp(bm - new_m)
+        o = o * alpha[..., None] + bo.astype(jnp.float32) * beta[..., None]
+        l = l * alpha + bl * beta
+        # rotate k/v to the next device; the last iteration's rotation
+        # would be unused, so skip the ICI exchange there
+        kc, vc = lax.cond(
+            i < n - 1,
+            lambda ks, vs: (lax.ppermute(ks, axis_name, shift),
+                            lax.ppermute(vs, axis_name, shift)),
+            lambda ks, vs: (ks, vs),
+            kc, vc)
+        return o, new_m, l, kc, vc
+
+    # initial carries must be marked varying over the mesh axis for the
+    # fori_loop carry types to match (shard_map vma rules)
+    def _varying(x):
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return x
+
+    o0 = _varying(jnp.zeros((B, H, S, D), jnp.float32))
+    m0 = _varying(jnp.full((B, H, S), -jnp.inf, jnp.float32))
+    l0 = _varying(jnp.zeros((B, H, S), jnp.float32))
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, kh, vh))
+    out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def ulysses_attention(q, k, v, axis_name: str = "cp", causal: bool = True,
+                      scale=None):
+    """Ulysses/DeepSpeed-style sequence parallelism: all-to-all the head
+    dim against the sequence dim so each device holds ALL positions for
+    H/cp heads, then dense local attention, then all-to-all back.
+    Local shards [B, S_local, H, D] with H % cp == 0.
+    """
+    n = lax.axis_size(axis_name)
+    B, S, H, D = q.shape
+    assert H % n == 0, f"heads {H} not divisible by cp degree {n}"
+
+    def seq2head(x):
+        # [B, S, H, D] -> [B, S*n, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    s = scale if scale is not None else 1.0 / (D ** 0.5)
+    qh = jnp.swapaxes(qg, 1, 2)
+    kh = jnp.swapaxes(kg, 1, 2)
+    vh = jnp.swapaxes(vg, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        Sg = logits.shape[-1]
+        mask = jnp.tril(jnp.ones((Sg, Sg), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(vh.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    out = jnp.swapaxes(out, 1, 2)
+    return head2seq(out)

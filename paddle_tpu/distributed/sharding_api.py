@@ -69,9 +69,12 @@ def shard_tensor(x: Tensor, mesh=None, placement=None) -> Tensor:
 def with_sharding_constraint(x: Tensor, *spec) -> Tensor:
     """In-jit sharding hint — analog of auto-parallel's per-tensor
     dims_mapping annotations consumed by completion.py; here XLA SPMD does
-    the propagation."""
+    the propagation. No-op in eager (non-traced) execution, mirroring the
+    reference's identity behavior at mp_degree=1."""
     from paddle_tpu.ops.dispatch import apply
 
+    if not isinstance(x._array, jax.core.Tracer):
+        return x
     mesh = get_hybrid_communicate_group().mesh
     ns = NamedSharding(mesh, PartitionSpec(*spec))
     return apply("sharding_constraint",

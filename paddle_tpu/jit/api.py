@@ -158,10 +158,15 @@ class TrainStep:
         self.loss_fn = loss_fn
         self._params = model.parameters()
         self._jitted = None
+        self._scan_jitted = None
         self._donate = donate
         self._opt_state = None
 
     def _build(self):
+        return jax.jit(self._make_step_fn(),
+                       donate_argnums=(0, 1) if self._donate else ())
+
+    def _make_step_fn(self):
         model = self.model
         opt = self.optimizer
         loss_fn = self.loss_fn
@@ -198,8 +203,49 @@ class TrainStep:
                     new_accums[k].append(na.get(k, acc_i[k]))
             return loss, new_params, new_accums
 
+        return step_fn
+
+    def run_scan(self, inputs_stacked, labels_stacked):
+        """Run a whole sequence of steps inside ONE XLA program via
+        lax.scan — amortizes dispatch latency to zero and lets XLA overlap
+        steps. inputs/labels have a leading [num_steps] dim. Returns the
+        per-step losses. (The analog of the reference's
+        Executor.train_from_dataset inner loop, compiled.)"""
+        if self._scan_jitted is None:
+            self.optimizer._ensure_state()
+            self._scan_jitted = self._build_scan()
+        opt = self.optimizer
+        param_arrays = [p._array for p in self._params]
+        accums = {k: list(v) for k, v in opt._accumulators.items()}
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        stepc = jnp.asarray(opt._step_count, jnp.int32)
+        xs = _unwrap(inputs_stacked)
+        ys = _unwrap(labels_stacked)
+        losses, new_params, new_accums = self._scan_jitted(
+            param_arrays, accums, lr, stepc, xs, ys)
+        for p, a in zip(self._params, new_params):
+            p._in_place_update(a)
+        for k in opt._accumulators:
+            opt._accumulators[k] = new_accums[k]
+        opt._step_count += int(xs.shape[0])
+        return Tensor._wrap(losses)
+
+    def _build_scan(self):
+        base_step = self._make_step_fn()
+
+        def scan_all(param_arrays, accums, lr, step0, xs, ys):
+            def body(carry, xy):
+                params, accs, st = carry
+                x, y = xy
+                loss, nparams, naccs = base_step(params, accs, lr, st, (x,), y)
+                return (nparams, naccs, st + 1), loss
+
+            (fparams, faccums, _), losses = jax.lax.scan(
+                body, (param_arrays, accums, step0), (xs, ys))
+            return losses, fparams, faccums
+
         donate = (0, 1) if self._donate else ()
-        return jax.jit(step_fn, donate_argnums=donate)
+        return jax.jit(scan_all, donate_argnums=donate)
 
     def __call__(self, *inputs, label=None):
         if label is None and len(inputs) >= 2:

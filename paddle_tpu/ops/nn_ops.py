@@ -771,6 +771,21 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     q, k, v = as_tensor(q), as_tensor(k), as_tensor(v)
     mask_arr = attn_mask._array if isinstance(attn_mask, Tensor) else attn_mask
 
+    # flash path: causal, no explicit mask, library-friendly shapes
+    if (mask_arr is None and is_causal and dropout_p == 0.0
+            and q._array.shape == k._array.shape):
+        import jax as _jax
+
+        B, S, H, D = q._array.shape
+        if _jax.default_backend() in ("tpu", "axon") and S >= 128 \
+                and S % 128 == 0 and D % 64 == 0:
+            from .pallas.flash_attention import flash_attention
+
+            return apply("flash_attention",
+                         lambda qa, ka, va: flash_attention(
+                             qa, ka, va, causal=True, scale=scale),
+                         q, k, v)
+
     def fn(qa, ka, va):
         d = qa.shape[-1]
         s = scale if scale is not None else 1.0 / np.sqrt(d)

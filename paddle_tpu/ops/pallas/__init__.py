@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the analog of the reference's hand-written fused
+CUDA ops (paddle/fluid/operators/fused/): where XLA's automatic fusion
+isn't enough (flash attention, MoE block matmuls), we drop to Pallas.
+"""
+from .flash_attention import flash_attention, pallas_sdpa_forward
+
+__all__ = ["flash_attention", "pallas_sdpa_forward"]

@@ -1,0 +1,183 @@
+"""Flash attention for TPU.
+
+Replaces the reference's fused_attention/FMHA CUDA path
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h) with a
+TPU-native blockwise kernel: the S x S score matrix never leaves VMEM.
+
+Two implementations:
+- `pallas_sdpa_forward`: our own Pallas forward kernel (online-softmax,
+  one (batch*head, q-block) program per grid step, k-blocks innermost with
+  VMEM accumulators) — used for inference and as the reference for tests.
+- `flash_attention`: full fwd+bwd path that routes to
+  jax.experimental.pallas.ops.tpu.flash_attention (the production-tuned
+  kernel shipped with jax) when shapes allow, falling back to plain XLA
+  attention otherwise. Training uses this.
+
+Layouts: public API takes paddle layout [B, S, H, D] and returns the same.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def _xla_attention(q, k, v, causal, scale):
+    """Dense fallback [B,H,S,D] -> [B,H,S,D]."""
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        S, T = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((S, T), bool), T - S)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# our own Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _sdpa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                     scale, causal, block_q, block_k, seq_len):
+    """Grid: (BH, num_q_blocks, num_k_blocks); k innermost. VMEM scratch
+    (acc, m, l) persists across the k dimension of the grid."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    if causal:
+        # skip k-blocks strictly above the causal diagonal
+        run = k_start <= q_start + block_q - 1
+    else:
+        run = jnp.bool_(True)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            mask = (q_start + rows) >= (k_start + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)  # [bq,1]
+        l_new = alpha[:, 0] * l_ref[:, 0] + jnp.sum(p, axis=-1)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        denom = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def pallas_sdpa_forward(q, k, v, causal: bool = True, scale=None,
+                        block_q: int = 256, block_k: int = 256,
+                        interpret: bool = False):
+    """Our Pallas flash forward. Input/output [B, S, H, D] (paddle layout).
+    Requires S % block == 0 (pad upstream)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0
+
+    # [B,S,H,D] -> [B*H, S, D]
+    def to_bh(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+    qh, kh, vh = to_bh(q), to_bh(k), to_bh(v)
+    grid = (B * H, S // block_q, S // block_k)
+
+    kernel = functools.partial(
+        _sdpa_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=S)
+
+    out = pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+    )(qh, kh, vh)
+
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# production path: jax's tuned TPU flash attention (fwd+bwd), XLA fallback
+# ---------------------------------------------------------------------------
+
+def _shapes_ok_for_lib(S, D):
+    return S >= 128 and S % 128 == 0 and D % 64 == 0
+
+
+def flash_attention(q, k, v, causal: bool = True, scale=None):
+    """[B,S,H,D] -> [B,S,H,D]; differentiable; picks the best backend."""
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    on_tpu = any(p.platform in ("tpu",) for p in
+                 (jax.devices()[0],)) or jax.default_backend() in ("tpu", "axon")
+    if on_tpu and _shapes_ok_for_lib(S, D):
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                BlockSizes,
+                flash_attention as lib_flash,
+            )
+
+            bs = BlockSizes.get_default()
+            out = lib_flash(qh, kh, vh, causal=causal, sm_scale=scale,
+                            block_sizes=bs)
+            return jnp.swapaxes(out, 1, 2)
+        except Exception:
+            pass
+    out = _xla_attention(qh, kh, vh, causal, scale)
+    return jnp.swapaxes(out, 1, 2)

@@ -5,7 +5,11 @@ Must run before jax initializes a backend.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Host env points JAX_PLATFORMS at the axon TPU plugin, and the axon
+# sitecustomize imports jax at interpreter start — so env vars alone are
+# too late. XLA_FLAGS is read lazily at backend init, and jax.config can
+# still flip the platform before first use.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,6 +17,8 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 # numerical-parity tests need exact fp32 matmuls; production keeps the
 # fast MXU default (bf16 passes) — this only affects the test process.

@@ -25,10 +25,14 @@ def main():
     on_tpu = backend in ("tpu", "axon")
 
     if on_tpu:
-        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
-                        num_heads=12, max_seq_len=1024, dropout=0.0)
-        batch = int(os.environ.get("BENCH_BATCH", "8"))
-        steps = int(os.environ.get("BENCH_STEPS", "20"))
+        # the BASELINE.md flagship: GPT-3 1.3B class. hidden=2048/head_dim=128
+        # saturates the MXU (hidden=768-class matmuls measured at <30% peak on
+        # v5e); batch 2 fits without remat — recompute-free beats every remat
+        # policy measured (0.432 vs 0.382 MFU pure-jax).
+        cfg = GPTConfig(vocab_size=32768, hidden_size=2048, num_layers=24,
+                        num_heads=16, max_seq_len=2048, dropout=0.0)
+        batch = int(os.environ.get("BENCH_BATCH", "2"))
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke mode
         cfg = GPTConfig.tiny(vocab=512, hidden=128, layers=2, heads=4, seq=128)
@@ -44,8 +48,6 @@ def main():
     step = jit.TrainStep(model, opt, model.loss_fn)
 
     seq = cfg.max_seq_len
-    ids = paddle.to_tensor(
-        np.random.randint(0, cfg.vocab_size, (batch, seq), np.int32))
 
     # multi-step: the whole timed region is ONE XLA program (lax.scan over
     # steps) so per-dispatch latency doesn't pollute the measurement
@@ -54,12 +56,12 @@ def main():
 
     t0 = time.time()
     losses = step.run_scan(ids_stack, ids_stack)  # compile + first run
-    losses._array.block_until_ready()
+    np.asarray(losses._array)  # full readback: block_until_ready is unreliable through the axon tunnel
     compile_s = time.time() - t0
 
     t1 = time.time()
     losses = step.run_scan(ids_stack, ids_stack)
-    losses._array.block_until_ready()
+    np.asarray(losses._array)  # full readback: block_until_ready is unreliable through the axon tunnel
     dt = time.time() - t1
     loss = losses[-1]
 
@@ -71,7 +73,7 @@ def main():
     mfu = tok_s * flops_tok / peak_flops
 
     result = {
-        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "metric": "gpt_1p3b_train_tokens_per_sec_per_chip",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.45, 4),

@@ -95,6 +95,25 @@ def apply(name: str, fn: Callable, *inputs: Tensor, amp_policy: str = None):
         out = fn(*arrays)
         return _wrap_outputs(out, None, False)
 
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        # Inside an outer jax trace (TrainStep's value_and_grad, to_static,
+        # vmap...): run fn directly so the OUTER AD differentiates it —
+        # eagerly calling jax.vjp here would linearize at trace time and
+        # force higher-order AD through custom_vjp ops (this is what
+        # silently knocked the pallas flash kernel back to dense attention
+        # in round 1). The tape node gets a lazy vjp for the rare case of
+        # tape backward under trace.
+        out = fn(*arrays)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        out_specs = [(o.shape, o.dtype) for o in outs]
+
+        def lazy_vjp(cts, _fn=fn, _arrays=arrays):
+            _, vjp_fn = jax.vjp(_fn, *_arrays)
+            return vjp_fn(cts)
+
+        node = Node(name, lazy_vjp, inputs, out_specs)
+        return _wrap_outputs(out, node, True)
+
     out, vjp_fn = jax.vjp(fn, *arrays)
     outs = out if isinstance(out, (tuple, list)) else (out,)
     out_specs = [(o.shape, o.dtype) for o in outs]

@@ -771,20 +771,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     q, k, v = as_tensor(q), as_tensor(k), as_tensor(v)
     mask_arr = attn_mask._array if isinstance(attn_mask, Tensor) else attn_mask
 
-    # flash path: causal, no explicit mask, library-friendly shapes
-    if (mask_arr is None and is_causal and dropout_p == 0.0
-            and q._array.shape == k._array.shape):
-        import jax as _jax
+    # flash path: no explicit mask/dropout. flash_attention is the single
+    # source of truth for routing — it checks backend + shapes internally
+    # and falls back to dense XLA attention (with a logged warning, and a
+    # bottom-right-aligned causal mask for Sq != Skv) when the pallas
+    # kernel can't be used.
+    if mask_arr is None and dropout_p == 0.0:
+        from .pallas.flash_attention import flash_attention
 
-        B, S, H, D = q._array.shape
-        if _jax.default_backend() in ("tpu", "axon") and S >= 128 \
-                and S % 128 == 0 and D % 64 == 0:
-            from .pallas.flash_attention import flash_attention
-
-            return apply("flash_attention",
-                         lambda qa, ka, va: flash_attention(
-                             qa, ka, va, causal=True, scale=scale),
-                         q, k, v)
+        return apply("flash_attention",
+                     lambda qa, ka, va: flash_attention(
+                         qa, ka, va, causal=is_causal, scale=scale),
+                     q, k, v)
 
     def fn(qa, ka, va):
         d = qa.shape[-1]
@@ -798,7 +796,8 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         ) * s
         if is_causal:
             S, T = logits.shape[-2], logits.shape[-1]
-            cmask = jnp.tril(jnp.ones((S, T), bool))
+            # bottom-right aligned for Sq != Skv (KV-cache continuation)
+            cmask = jnp.tril(jnp.ones((S, T), bool), T - S)
             logits = jnp.where(cmask, logits, -1e30)
         if mask_arr is not None:
             logits = logits + mask_arr.astype(logits.dtype)

@@ -73,6 +73,7 @@ class Node:
         "seq",
         "vjp_fn",
         "inputs",
+        "input_versions",
         "out_specs",
         "out_cts",
         "hooks",
@@ -86,6 +87,10 @@ class Node:
         self.seq = _node_counter
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)
+        # version snapshot: detects in-place mutation (setitem/set_value/
+        # optimizer update) between forward record and backward — the
+        # analog of torch/paddle's saved-tensor version counter
+        self.input_versions = [getattr(t, "_version", 0) for t in inputs]
         self.out_specs = out_specs
         self.out_cts: List[Optional[object]] = [None] * len(out_specs)
         self.hooks: List[Callable] = []
@@ -177,6 +182,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
             node = nodes[seq]
             if all(ct is None for ct in node.out_cts):
                 continue  # branch never contributed to the loss
+            for t, ver in zip(node.inputs, node.input_versions):
+                if getattr(t, "_version", 0) != ver:
+                    raise RuntimeError(
+                        f"a tensor saved for backward of '{node.name}' was "
+                        f"mutated in place (version {ver} -> {t._version}) "
+                        f"after being used in the forward pass; gradients "
+                        f"through the pre-mutation value would be wrong")
             cts = node.materialized_cts()
             in_cts = node.vjp_fn(cts)
             for hook in node.hooks:

@@ -8,6 +8,7 @@ analog of fleet/layers/mpu/random.py:35.
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 
 import jax
@@ -52,6 +53,29 @@ class Generator:
 
 _default_generator = Generator(0)
 
+# Traced-key scope: inside a compiled step (TrainStep/DistributedTrainStep)
+# the per-step PRNG key is a *traced argument*; random ops must derive from
+# it instead of the eager generator, otherwise the key is baked into the
+# trace as a constant and every compiled step reuses the identical dropout
+# mask (ADVICE r1 medium). Each next_key() inside the scope folds in a
+# fresh counter — the fold sequence is fixed at trace time, so each random
+# op site gets a distinct, step-varying key.
+_key_scope_tls = threading.local()
+
+
+@contextlib.contextmanager
+def key_scope(key):
+    prev = getattr(_key_scope_tls, "scope", None)
+    _key_scope_tls.scope = [key, 0]
+    try:
+        yield
+    finally:
+        _key_scope_tls.scope = prev
+
+
+def in_key_scope() -> bool:
+    return getattr(_key_scope_tls, "scope", None) is not None
+
 
 def default_generator() -> Generator:
     return _default_generator
@@ -63,6 +87,11 @@ def seed(value: int) -> Generator:
 
 
 def next_key():
+    scope = getattr(_key_scope_tls, "scope", None)
+    if scope is not None:
+        k = jax.random.fold_in(scope[0], scope[1])
+        scope[1] += 1
+        return k
     return _default_generator.next_key()
 
 

@@ -34,6 +34,7 @@ class Tensor:
         "name",
         "persistable",
         "dist_spec",  # PartitionSpec annotation consumed by spmd.TrainStep
+        "_version",  # bumped on in-place mutation; tape nodes snapshot it
         "__weakref__",
     )
 
@@ -61,6 +62,7 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.dist_spec = None
+        self._version = 0
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -74,6 +76,7 @@ class Tensor:
         t.name = ""
         t.persistable = False
         t.dist_spec = None
+        t._version = 0
         return t
 
     # -- metadata ----------------------------------------------------------
@@ -216,6 +219,7 @@ class Tensor:
         else:
             arr = jnp.asarray(np.asarray(value))
         self._array = arr.astype(self._array.dtype).reshape(self._array.shape)
+        self._version += 1
 
     def copy_(self, other, blocking=True):
         self.set_value(other)
@@ -225,6 +229,7 @@ class Tensor:
         """Optimizer-style parameter update; keeps identity and autograd
         leaf status. Old buffer is donated conceptually (PJRT frees it)."""
         self._array = new_array
+        self._version += 1
 
     # -- iteration / indexing installed by ops package ---------------------
     def __iter__(self):

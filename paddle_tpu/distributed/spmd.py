@@ -85,7 +85,13 @@ class DistributedTrainStep:
         self.sharding_stage = sharding_stage
         self.batch_axes = tuple(a for a in batch_axes
                                 if self.hcg.axis_size(a) > 1) or None
-        self._params = model.parameters()
+        optimizer._ensure_state()
+        # trainable ∩ optimizer-owned params (frozen params stay baked as
+        # replicated constants; accumulator slots indexed via _acc_idx)
+        opt_index = {id(p): j for j, p in enumerate(optimizer._parameter_list)}
+        self._params = [p for p in model.parameters()
+                        if not p.stop_gradient and id(p) in opt_index]
+        self._acc_idx = [opt_index[id(p)] for p in self._params]
         self._jitted = None
         self._donate = donate
         self._placed = False
@@ -107,12 +113,12 @@ class DistributedTrainStep:
             p._array = jax.device_put(p._array, ns)
         opt = self.optimizer
         opt._ensure_state()
-        pspecs = specs
         for k, lst in opt._accumulators.items():
-            for i, a in enumerate(lst):
-                s = accum_pspec(pspecs[i], self._params[i], self.hcg,
-                                self.sharding_stage)
-                lst[i] = jax.device_put(a, NamedSharding(self.hcg.mesh, s))
+            for out_pos, j in enumerate(self._acc_idx):
+                s = accum_pspec(specs[out_pos], self._params[out_pos],
+                                self.hcg, self.sharding_stage)
+                lst[j] = jax.device_put(lst[j],
+                                        NamedSharding(self.hcg.mesh, s))
         self._placed = True
 
     def _build(self):
@@ -125,7 +131,8 @@ class DistributedTrainStep:
         opt._ensure_state()
         accum_names = list(opt._accumulators.keys())
         single_update = opt._single_update
-        extras_list = [opt._per_param_extras(i) for i in range(len(params))]
+        extras_list = [opt._per_param_extras(j) for j in self._acc_idx]
+        grad_clip = opt._grad_clip
         pspecs, param_shardings = self._param_shardings()
         acc_shardings = {
             k: [NamedSharding(mesh, accum_pspec(pspecs[i], params[i], hcg,
@@ -136,22 +143,28 @@ class DistributedTrainStep:
         batch_spec = P(self.batch_axes)
         batch_sharding = NamedSharding(mesh, batch_spec)
         repl = NamedSharding(mesh, P())
+        from paddle_tpu.core import random as random_mod
 
-        def forward_loss(param_arrays, inputs, label):
+        def forward_loss(param_arrays, inputs, label, rng):
             originals = [p._array for p in params]
             try:
                 for p, a in zip(params, param_arrays):
                     p._array = a
-                out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
-                loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
+                with random_mod.key_scope(rng):
+                    out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
+                    loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
                 return loss._array if isinstance(loss, Tensor) else loss
             finally:
                 for p, o in zip(params, originals):
                     p._array = o
 
-        def step_fn(param_arrays, accums, lr, step, inputs, label):
+        def step_fn(param_arrays, accums, lr, step, inputs, label, rng):
             loss, grads = jax.value_and_grad(forward_loss)(
-                param_arrays, inputs, label)
+                param_arrays, inputs, label, rng)
+            if grad_clip is not None:
+                # norms reduce over logical global arrays: XLA inserts the
+                # cross-mesh collectives (hybrid_parallel_optimizer.py:186)
+                grads = grad_clip._clip_arrays(list(grads))
             new_params, new_accums = [], {k: [] for k in accum_names}
             for i, (p, g) in enumerate(zip(param_arrays, grads)):
                 acc_i = {k: accums[k][i] for k in accum_names}
@@ -189,15 +202,18 @@ class DistributedTrainStep:
         in_arrays = tuple(
             jax.device_put(_unwrap(i), bs) for i in inputs)
         label_arr = jax.device_put(_unwrap(label), bs) if label is not None else None
+        from paddle_tpu.core import random as random_mod
+        from paddle_tpu.jit.api import gather_accums, scatter_accums
+
         param_arrays = [p._array for p in self._params]
-        accums = {k: list(v) for k, v in opt._accumulators.items()}
+        accums = gather_accums(opt, self._acc_idx)
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
         loss, new_params, new_accums = self._jitted(
-            param_arrays, accums, lr, stepc, in_arrays, label_arr)
+            param_arrays, accums, lr, stepc, in_arrays, label_arr,
+            random_mod.next_key())
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
-        for k in opt._accumulators:
-            opt._accumulators[k] = new_accums[k]
+        scatter_accums(opt, self._acc_idx, new_accums)
         opt._step_count += 1
         return Tensor._wrap(loss)

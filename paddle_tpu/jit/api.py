@@ -138,6 +138,19 @@ def not_to_static(fn):
     return fn
 
 
+def gather_accums(opt, acc_idx):
+    """Select the accumulator slots for the trained-param subset (aligned
+    with acc_idx into the optimizer's parameter list)."""
+    return {k: [v[j] for j in acc_idx] for k, v in opt._accumulators.items()}
+
+
+def scatter_accums(opt, acc_idx, new_accums):
+    """Write updated accumulator slots back to their optimizer positions."""
+    for k in opt._accumulators:
+        for out_pos, j in enumerate(acc_idx):
+            opt._accumulators[k][j] = new_accums[k][out_pos]
+
+
 class TrainStep:
     """One fully-compiled training step over (model, optimizer, loss_fn).
 
@@ -156,7 +169,16 @@ class TrainStep:
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
-        self._params = model.parameters()
+        optimizer._ensure_state()
+        # The traced/updated set is the intersection of the model's
+        # trainable params (stop_gradient=False — frozen params stay baked
+        # as constants, matching eager Optimizer.step skipping grad-None
+        # params) and the optimizer's parameter list (whose accumulator
+        # slots we must index consistently).
+        opt_index = {id(p): j for j, p in enumerate(optimizer._parameter_list)}
+        self._params = [p for p in model.parameters()
+                        if not p.stop_gradient and id(p) in opt_index]
+        self._acc_idx = [opt_index[id(p)] for p in self._params]
         self._jitted = None
         self._scan_jitted = None
         self._donate = donate
@@ -166,6 +188,17 @@ class TrainStep:
         return jax.jit(self._make_step_fn(),
                        donate_argnums=(0, 1) if self._donate else ())
 
+    def _gather_accums(self):
+        return gather_accums(self.optimizer, self._acc_idx)
+
+    def _scatter_accums(self, new_accums):
+        scatter_accums(self.optimizer, self._acc_idx, new_accums)
+
+    def _next_step_key(self):
+        from paddle_tpu.core import random as random_mod
+
+        return random_mod.next_key()
+
     def _make_step_fn(self):
         model = self.model
         opt = self.optimizer
@@ -174,25 +207,32 @@ class TrainStep:
         opt._ensure_state()
         single_update = opt._single_update
         accum_names = list(opt._accumulators.keys())
+        grad_clip = opt._grad_clip
+        from paddle_tpu.core import random as random_mod
 
-        def forward_loss(param_arrays, inputs, label):
+        def forward_loss(param_arrays, inputs, label, rng):
             # bind arrays into the live Parameter objects, run eager forward
-            # under trace, restore after
+            # under trace, restore after. rng is the per-step traced key that
+            # dropout & friends derive from (random.key_scope).
             originals = [p._array for p in params]
             try:
                 for p, a in zip(params, param_arrays):
                     p._array = a
-                out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
-                loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
+                with random_mod.key_scope(rng):
+                    out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
+                    loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
                 return loss._array if isinstance(loss, Tensor) else loss
             finally:
                 for p, o in zip(params, originals):
                     p._array = o
 
-        extras_list = [opt._per_param_extras(i) for i in range(len(params))]
+        extras_list = [opt._per_param_extras(j) for j in self._acc_idx]
 
-        def step_fn(param_arrays, accums, lr, step, inputs, label):
-            loss, grads = jax.value_and_grad(forward_loss)(param_arrays, inputs, label)
+        def step_fn(param_arrays, accums, lr, step, inputs, label, rng):
+            loss, grads = jax.value_and_grad(forward_loss)(
+                param_arrays, inputs, label, rng)
+            if grad_clip is not None:
+                grads = grad_clip._clip_arrays(list(grads))
             new_params, new_accums = [], {k: [] for k in accum_names}
             for i, (p, g) in enumerate(zip(param_arrays, grads)):
                 acc_i = {k: accums[k][i] for k in accum_names}
@@ -216,28 +256,29 @@ class TrainStep:
             self._scan_jitted = self._build_scan()
         opt = self.optimizer
         param_arrays = [p._array for p in self._params]
-        accums = {k: list(v) for k, v in opt._accumulators.items()}
+        accums = self._gather_accums()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
         xs = _unwrap(inputs_stacked)
         ys = _unwrap(labels_stacked)
         losses, new_params, new_accums = self._scan_jitted(
-            param_arrays, accums, lr, stepc, xs, ys)
+            param_arrays, accums, lr, stepc, xs, ys, self._next_step_key())
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
-        for k in opt._accumulators:
-            opt._accumulators[k] = new_accums[k]
+        self._scatter_accums(new_accums)
         opt._step_count += int(xs.shape[0])
         return Tensor._wrap(losses)
 
     def _build_scan(self):
         base_step = self._make_step_fn()
 
-        def scan_all(param_arrays, accums, lr, step0, xs, ys):
+        def scan_all(param_arrays, accums, lr, step0, xs, ys, rng):
             def body(carry, xy):
                 params, accs, st = carry
                 x, y = xy
-                loss, nparams, naccs = base_step(params, accs, lr, st, (x,), y)
+                loss, nparams, naccs = base_step(
+                    params, accs, lr, st, (x,), y,
+                    jax.random.fold_in(rng, st))
                 return (nparams, naccs, st + 1), loss
 
             (fparams, faccums, _), losses = jax.lax.scan(
@@ -256,20 +297,16 @@ class TrainStep:
             self._jitted = self._build()
         opt = self.optimizer
         param_arrays = [p._array for p in self._params]
-        accums = {k: list(v) for k, v in opt._accumulators.items()}
+        accums = self._gather_accums()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
         in_arrays = tuple(_unwrap(i) for i in inputs)
         label_arr = _unwrap(label) if label is not None else None
-        # dropout etc must be retraced per call? No: layers draw keys at
-        # trace time. For training determinism under jit, models use
-        # functional dropout with key passed in — v1 keeps dropout off in
-        # compiled steps (eval-mode) unless model handles keys.
         loss, new_params, new_accums = self._jitted(
-            param_arrays, accums, lr, stepc, in_arrays, label_arr)
+            param_arrays, accums, lr, stepc, in_arrays, label_arr,
+            self._next_step_key())
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
-        for k in opt._accumulators:
-            opt._accumulators[k] = new_accums[k]
+        self._scatter_accums(new_accums)
         opt._step_count += 1
         return Tensor._wrap(loss)

@@ -1,7 +1,9 @@
 """Gradient clipping — analog of python/paddle/fluid/clip.py
-(ClipGradByGlobalNorm etc.), consumed by optimizer.step. Under hybrid
-parallel the mp/pp-aware variant lives in distributed/hybrid_optimizer
-(analog of hybrid_parallel_optimizer.py:186's mp-aware clip).
+(ClipGradByGlobalNorm etc.), consumed by optimizer.step (eager) and by
+the compiled steps via `_clip_arrays` (jit.TrainStep,
+distributed.DistributedTrainStep). Under SPMD the compiled form IS the
+mp/pp-aware clip of hybrid_parallel_optimizer.py:186: the norm reduction
+runs on logical global arrays and XLA inserts the mesh collectives.
 """
 from __future__ import annotations
 
@@ -12,6 +14,18 @@ from paddle_tpu.core.tensor import Tensor
 
 class ClipGradBase:
     def __call__(self, params_grads):
+        arrs = self._clip_arrays([None if g is None else g._array
+                                  for _, g in params_grads])
+        return [(p, g if a is None else Tensor._wrap(a))
+                for (p, g), a in zip(params_grads, arrs)]
+
+    def _clip_arrays(self, grads):
+        """jax-traceable form over raw grad arrays (None entries pass
+        through) — used INSIDE compiled train steps (TrainStep /
+        DistributedTrainStep), where eager Tensor wrapping is wasted work.
+        Under pjit the norm reductions run on logical global arrays, so
+        XLA inserts the cross-shard collectives — this is the mesh-aware
+        clip of hybrid_parallel_optimizer.py:186 for free."""
         raise NotImplementedError
 
 
@@ -20,29 +34,24 @@ class ClipGradByValue(ClipGradBase):
         self.max = float(max)
         self.min = float(min) if min is not None else -self.max
 
-    def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            out.append((p, Tensor._wrap(jnp.clip(g._array, self.min, self.max))))
-        return out
+    def _clip_arrays(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
 
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
 
-    def __call__(self, params_grads):
+    def _clip_arrays(self, grads):
         out = []
-        for p, g in params_grads:
+        for g in grads:
             if g is None:
-                out.append((p, g))
+                out.append(None)
                 continue
-            norm = jnp.sqrt(jnp.sum(jnp.square(g._array.astype(jnp.float32))))
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
-            out.append((p, Tensor._wrap((g._array * scale).astype(g._array.dtype))))
+            out.append((g * scale).astype(g.dtype))
         return out
 
 
@@ -51,19 +60,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
         self.group_name = group_name
 
-    def __call__(self, params_grads):
-        sq = [
-            jnp.sum(jnp.square(g._array.astype(jnp.float32)))
-            for _, g in params_grads if g is not None
-        ]
+    def _clip_arrays(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads if g is not None]
         if not sq:
-            return params_grads
+            return grads
         global_norm = jnp.sqrt(sum(sq))
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None:
-                out.append((p, g))
-                continue
-            out.append((p, Tensor._wrap((g._array * scale).astype(g._array.dtype))))
-        return out
+        return [None if g is None else (g * scale).astype(g.dtype)
+                for g in grads]

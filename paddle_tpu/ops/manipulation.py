@@ -272,10 +272,65 @@ def getitem(x, item):
 
 
 def setitem(x, item, value):
-    """In-place __setitem__ via functional .at[] update (eager only)."""
+    """In-place __setitem__ via functional .at[] update.
+
+    When `x` participates in autodiff, the overwrite is recorded as a
+    differentiable op (the analog of Paddle's set_value_grad: the input
+    cotangent is zeroed at the overwritten positions, the value receives
+    the cotangent gathered from them). Without recording, backward through
+    a mutated non-leaf silently used the pre-mutation graph (ADVICE r1)."""
+    from paddle_tpu.core.autograd import is_grad_enabled
+
     key = _prep_index(item)
     v = value._array if isinstance(value, Tensor) else jnp.asarray(value)
-    x._array = x._array.at[key].set(v.astype(x._array.dtype) if hasattr(v, "astype") else v)
+    if hasattr(v, "astype"):
+        v = v.astype(x._array.dtype)
+
+    def _set(a, vv):
+        # numpy setitem broadcasting: leading size-1 dims of the value may
+        # be dropped to fit the target slot; jax .at[].set is stricter, so
+        # only pay the eval_shape trace when the strict form rejects it
+        try:
+            return a.at[key].set(vv)
+        except (ValueError, TypeError):
+            tgt_shape = jax.eval_shape(lambda t: t[key], a).shape
+            while getattr(vv, "ndim", 0) > len(tgt_shape) and vv.shape[0] == 1:
+                vv = vv[0]
+            return a.at[key].set(jnp.broadcast_to(vv, tgt_shape))
+
+    needs_grad = is_grad_enabled() and (
+        x._creator is not None
+        or not x.stop_gradient
+        or (isinstance(value, Tensor) and not value.stop_gradient)
+    ) and jnp.issubdtype(x._array.dtype, jnp.inexact)
+
+    if not needs_grad:
+        x._array = _set(x._array, v)
+        x._version += 1
+        return x
+
+    if x._creator is None and not x.stop_gradient:
+        raise RuntimeError(
+            "in-place __setitem__ on a leaf tensor with stop_gradient=False "
+            "is not supported (its .grad would no longer match the stored "
+            "value); use paddle.no_grad() or assign to a cloned tensor")
+
+    # snapshot x's identity so the tape edge points at the PRE-mutation
+    # tensor, then re-point x at the op output (keeps in-place semantics)
+    old = Tensor._wrap(x._array, stop_gradient=x.stop_gradient,
+                       creator=x._creator, out_idx=x._out_idx)
+    if isinstance(value, Tensor):
+        new = apply("setitem",
+                    lambda a, vv: _set(a, vv.astype(a.dtype)), old, value)
+    else:
+        new = apply("setitem", lambda a: _set(a, v), old)
+    x._array = new._array
+    x._creator = new._creator
+    x._out_idx = new._out_idx
+    x.stop_gradient = new.stop_gradient
+    # invalidate nodes that saved x BEFORE the mutation: their cotangent
+    # would otherwise route through the new creator (wrong values)
+    x._version += 1
     return x
 
 

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.core import random as random_mod
 from paddle_tpu.core.random import next_key
 from paddle_tpu.core.tensor import Tensor
 
@@ -511,14 +512,29 @@ def embedding(ids, weight, padding_idx=None, sparse=False):
     return apply("embedding", fn, weight)
 
 
+_dropout_trace_warned = False
+
+
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
-    """Dropout. Analog of phi DropoutKernel; RNG comes from the global
-    Generator key chain (core/random.py) — under jit tracing the key is a
-    captured constant, so use nn.Dropout layers (which re-key per call) for
-    training loops compiled with TrainStep."""
+    """Dropout. Analog of phi DropoutKernel. RNG comes from the global
+    Generator key chain (core/random.py); inside a compiled step the key
+    derives from the step's traced key (random.key_scope) so every step
+    gets a fresh mask. Tracing dropout OUTSIDE a key scope would bake a
+    constant key (identical mask every step) — warn loudly."""
     x = as_tensor(x)
     if not training or p == 0.0:
         return x
+    if isinstance(x._array, jax.core.Tracer) and not random_mod.in_key_scope():
+        global _dropout_trace_warned
+        if not _dropout_trace_warned:
+            import warnings
+
+            warnings.warn(
+                "dropout traced with a constant PRNG key: every execution of "
+                "this compiled function will reuse the SAME dropout mask. "
+                "Use jit.TrainStep (which threads a per-step key) or wrap "
+                "the call in paddle_tpu.core.random.key_scope(key).")
+            _dropout_trace_warned = True
     key = next_key()
     keep = 1.0 - p
 
@@ -585,38 +601,90 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0):
     """Analog of paddle.nn.functional.cross_entropy
-    (python/paddle/nn/functional/loss.py)."""
+    (python/paddle/nn/functional/loss.py). use_softmax=False means `input`
+    is already a probability distribution over `axis` (paddle semantics):
+    the loss is plain NLL -log(p[label]) / -sum(label*log(p))."""
     input = as_tensor(input)
-    if label_smoothing > 0.0 and not soft_label:
-        num_classes = input.shape[axis]
+
+    def _hard_labels():
         lab = label._array if isinstance(label, Tensor) else jnp.asarray(label)
         if lab.ndim == input.ndim and lab.shape[axis] == 1:
             lab = jnp.squeeze(lab, axis)
-        onehot = jax.nn.one_hot(lab, num_classes, dtype=jnp.float32)
+        return lab
+
+    # keep the ORIGINAL hard labels: weight selection and the valid-count
+    # must index by them even after label smoothing converts to soft
+    hard_lab = None if soft_label else _hard_labels()
+
+    smoothed = label_smoothing > 0.0 and not soft_label
+    if smoothed:
+        num_classes = input.shape[axis]
+        onehot = jax.nn.one_hot(hard_lab, num_classes, dtype=jnp.float32,
+                                axis=axis)
         soft = onehot * (1 - label_smoothing) + label_smoothing / num_classes
         label = Tensor._wrap(soft)
         soft_label = True
 
-    loss = softmax_with_cross_entropy(
-        input, label, soft_label=soft_label, axis=axis, ignore_index=ignore_index
-    )
+    if use_softmax:
+        loss = softmax_with_cross_entropy(
+            input, label, soft_label=soft_label, axis=axis,
+            ignore_index=ignore_index)
+    else:
+        # input is probabilities: NLL without the softmax
+        if soft_label:
+            label_t = as_tensor(label)
+            loss = apply(
+                "nll_soft",
+                lambda p, lb: -jnp.sum(
+                    lb * jnp.log(jnp.maximum(p.astype(jnp.float32), 1e-30)),
+                    axis=axis, keepdims=True),
+                input, label_t)
+        else:
+            idx = jnp.expand_dims(hard_lab, axis).astype(jnp.int32)
+            mask = idx != ignore_index
+
+            def fn(p):
+                logp = jnp.log(jnp.maximum(p.astype(jnp.float32), 1e-30))
+                ll = jnp.take_along_axis(logp, jnp.where(mask, idx, 0),
+                                         axis=axis)
+                return jnp.where(mask, -ll, 0.0).astype(p.dtype)
+
+            loss = apply("nll_hard", fn, input)
+
+    if smoothed:
+        # the soft-CE path has no ignore_index masking: zero ignored rows
+        # so the valid-count mean below stays correct
+        ig_mask = jnp.expand_dims(hard_lab != ignore_index, axis)
+        loss = apply("ce_ignore_mask",
+                     lambda l: jnp.where(ig_mask, l, 0.0).astype(l.dtype),
+                     loss)
+
+    wsel = None
     if weight is not None:
+        if hard_lab is None:
+            raise ValueError(
+                "weight with soft_label=True is not supported (pass hard "
+                "labels, optionally with label_smoothing)")
         w = weight._array if isinstance(weight, Tensor) else jnp.asarray(weight)
-        lab = label._array if isinstance(label, Tensor) else jnp.asarray(label)
-        if lab.ndim == input.ndim and lab.shape[axis] == 1:
-            lab = jnp.squeeze(lab, axis)
-        wsel = jnp.take(w, lab.astype(jnp.int32))
-        loss = apply("ce_weight", lambda l: l * jnp.expand_dims(wsel, axis), loss)
+        safe_lab = jnp.where(hard_lab == ignore_index, 0, hard_lab)
+        wsel = jnp.where(hard_lab == ignore_index, 0.0,
+                         jnp.take(w, safe_lab.astype(jnp.int32)))
+        loss = apply("ce_weight",
+                     lambda l: l * jnp.expand_dims(wsel, axis).astype(l.dtype),
+                     loss)
 
     loss_sq = apply("squeeze_loss", lambda l: jnp.squeeze(l, axis), loss)
     if reduction == "none":
         return loss_sq
-    if reduction == "mean" and not soft_label:
-        # paddle semantics: mean over non-ignored labels only
-        lab_for_count = label._array if isinstance(label, Tensor) else jnp.asarray(label)
-        if lab_for_count.ndim == input.ndim and lab_for_count.shape[axis] == 1:
-            lab_for_count = jnp.squeeze(lab_for_count, axis)
-        valid = (lab_for_count != ignore_index).astype(jnp.float32)
+    if reduction == "mean" and hard_lab is not None:
+        # paddle semantics: mean over non-ignored labels; with class
+        # weights the denominator is the sum of selected weights
+        if wsel is not None:
+            return apply(
+                "reduce_loss",
+                lambda l: jnp.sum(l) / jnp.maximum(jnp.sum(wsel), 1e-12),
+                loss_sq)
+        valid = (hard_lab != ignore_index).astype(jnp.float32)
         return apply(
             "reduce_loss",
             lambda l: jnp.sum(l) / jnp.maximum(jnp.sum(valid), 1.0), loss_sq)

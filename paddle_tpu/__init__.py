@@ -74,6 +74,8 @@ from paddle_tpu.ops.random_ops import (
     randn, randperm, shuffle, standard_normal, uniform,
 )
 
+from paddle_tpu import autograd  # noqa: E402
+from paddle_tpu.core.pylayer import PyLayer  # noqa: E402
 from paddle_tpu import amp  # noqa: E402
 from paddle_tpu import nn  # noqa: E402
 from paddle_tpu import optimizer  # noqa: E402

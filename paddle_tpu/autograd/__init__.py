@@ -1,0 +1,20 @@
+"""paddle.autograd namespace — mirrors python/paddle/autograd/__init__.py:
+backward helpers, functional grad, and user-defined PyLayer ops."""
+from paddle_tpu.core.autograd import (  # noqa: F401
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from paddle_tpu.core.pylayer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Analog of paddle.autograd.backward."""
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad", "no_grad",
+           "enable_grad", "set_grad_enabled", "is_grad_enabled"]

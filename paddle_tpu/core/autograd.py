@@ -151,12 +151,30 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
 
+    # leaf cotangents accumulate here first so hooks fire ONCE per leaf
+    # with the fully-summed gradient (GradNodeAccumulation semantics) —
+    # not once per partial contribution
+    leaf_cts: dict = {}
+
     def leaf_accumulate(t, ct):
-        if sink is not None:
-            key = id(t)
-            sink[key] = ct if key not in sink else sink[key] + ct
+        entry = leaf_cts.get(id(t))
+        if entry is None:
+            leaf_cts[id(t)] = [t, ct]
         else:
-            t._accumulate_grad(ct)
+            entry[1] = entry[1] + ct
+
+    def flush_leaves():
+        for t, ct in leaf_cts.values():
+            if getattr(t, "_leaf_hooks", None):
+                for hook in list(t._leaf_hooks):
+                    out = hook(ct)
+                    if out is not None:
+                        ct = out
+            if sink is not None:
+                key = id(t)
+                sink[key] = ct if key not in sink else sink[key] + ct
+            else:
+                t._accumulate_grad(ct)
 
     roots = []
     with no_grad():
@@ -172,6 +190,7 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 leaf_accumulate(t, seed_ct)
 
         if not roots:
+            flush_leaves()
             return
 
         nodes = {}
@@ -208,6 +227,8 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
                 node.out_cts = [None] * len(node.out_specs)
             else:
                 node.out_cts = [None] * len(node.out_specs)
+
+        flush_leaves()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,

@@ -1,0 +1,139 @@
+"""PyLayer — user-defined autograd ops on the tape engine.
+
+Analog of the reference's eager PyLayer
+(paddle/fluid/eager/pylayer/py_layer_node.h, python API
+python/paddle/autograd/py_layer.py): `forward` runs with grad recording
+disabled, a single tape Node is recorded whose backward calls the
+user-defined `backward` with the output cotangents.
+
+    class cus_tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            y, = ctx.saved_tensor()
+            return dy * (1 - paddle.square(y))
+
+    out = cus_tanh.apply(x)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd import Node, is_grad_enabled, no_grad
+from .tensor import Tensor
+
+
+class PyLayerContext:
+    """The `ctx` handed to forward/backward (analog of PyLayerContext in
+    python/paddle/autograd/py_layer.py)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    # reference-compat aliases
+    saved_tensors = property(lambda self: self._saved)
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tuple(tensors)
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+def _flatten_tensors(args):
+    out = []
+    for a in args:
+        if isinstance(a, Tensor):
+            out.append(a)
+        elif isinstance(a, (list, tuple)):
+            out.extend(_flatten_tensors(a))
+    return out
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError("PyLayer subclasses must define forward")
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError("PyLayer subclasses must define backward")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = _flatten_tensors(args) + _flatten_tensors(
+            list(kwargs.values()))
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient and jnp.issubdtype(t._array.dtype, jnp.inexact)
+            for t in tensor_inputs)
+
+        # ops inside forward are NOT recorded — the PyLayer node replaces
+        # them (py_layer_node.h semantics)
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        if not needs_grad:
+            return outs
+
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+        out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+        out_specs = [(o._array.shape, o._array.dtype) for o in out_tensors]
+        diff_inputs = [t for t in tensor_inputs
+                       if not t.stop_gradient
+                       and jnp.issubdtype(t._array.dtype, jnp.inexact)]
+
+        def vjp_fn(cts):
+            ct_list = list(cts) if isinstance(cts, (tuple, list)) else [cts]
+            ct_tensors = [Tensor._wrap(c) for c in ct_list]
+            with no_grad():
+                gin = cls.backward(ctx, *ct_tensors)
+            gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            # paddle contract: backward returns one grad per *forward
+            # tensor input that requires grad*, in order (None allowed)
+            if len(gin) == len(tensor_inputs) and len(tensor_inputs) != len(diff_inputs):
+                gin = [g for t, g in zip(tensor_inputs, gin)
+                       if not t.stop_gradient
+                       and jnp.issubdtype(t._array.dtype, jnp.inexact)]
+            if len(gin) != len(diff_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(gin)} grads for "
+                    f"{len(diff_inputs)} differentiable inputs")
+            return tuple(
+                None if g is None else (g._array if isinstance(g, Tensor) else jnp.asarray(g))
+                for g in gin)
+
+        node = Node(cls.__name__, vjp_fn, diff_inputs, out_specs)
+        idx = 0
+        rewrapped = []
+        for o in out_list:
+            if isinstance(o, Tensor):
+                rewrapped.append(Tensor._wrap(o._array, stop_gradient=False,
+                                              creator=node, out_idx=idx))
+                idx += 1
+            else:
+                rewrapped.append(o)
+        return rewrapped[0] if single else tuple(rewrapped)
+
+
+class PyLayerContextLegacy(PyLayerContext):
+    pass

@@ -35,6 +35,7 @@ class Tensor:
         "persistable",
         "dist_spec",  # PartitionSpec annotation consumed by spmd.TrainStep
         "_version",  # bumped on in-place mutation; tape nodes snapshot it
+        "_leaf_hooks",  # grad hooks on leaf tensors (GradNodeAccumulation)
         "__weakref__",
     )
 
@@ -63,6 +64,7 @@ class Tensor:
         self.persistable = False
         self.dist_spec = None
         self._version = 0
+        self._leaf_hooks = None
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -77,6 +79,7 @@ class Tensor:
         t.persistable = False
         t.dist_spec = None
         t._version = 0
+        t._leaf_hooks = None
         return t
 
     # -- metadata ----------------------------------------------------------
@@ -158,11 +161,12 @@ class Tensor:
 
     def register_hook(self, hook):
         """Grad hook fired when this tensor's cotangent is materialized
-        during backward; analog of egr RegisterGradientHookForTensor.
-        The hook receives/returns a Tensor (or None to keep unchanged)."""
-        if self._creator is None:
-            raise RuntimeError("register_hook on leaf tensors is not supported yet")
-        node, idx = self._creator, self._out_idx
+        during backward; analog of egr RegisterGradientHookForTensor. For
+        leaf tensors the hook fires at grad accumulation time — the
+        GradNodeAccumulation hook point (accumulation_node.h) that e.g.
+        DataParallel reducers attach to. The hook receives/returns a
+        Tensor (or None to keep unchanged). Returns a handle with
+        .remove()."""
 
         def array_hook(ct, _hook=hook):
             out = _hook(Tensor._wrap(ct))
@@ -170,8 +174,22 @@ class Tensor:
                 return None
             return out._array if isinstance(out, Tensor) else out
 
-        node.out_hooks.setdefault(idx, []).append(array_hook)
-        return array_hook
+        if self._creator is None:
+            if self._leaf_hooks is None:
+                self._leaf_hooks = []
+            hooks_list = self._leaf_hooks
+            hooks_list.append(array_hook)
+        else:
+            node, idx = self._creator, self._out_idx
+            hooks_list = node.out_hooks.setdefault(idx, [])
+            hooks_list.append(array_hook)
+
+        class _Handle:
+            def remove(self, _lst=hooks_list, _h=array_hook):
+                if _h in _lst:
+                    _lst.remove(_h)
+
+        return _Handle()
 
     # -- host interop ------------------------------------------------------
     def numpy(self) -> np.ndarray:
@@ -213,13 +231,19 @@ class Tensor:
         )
 
     # -- in-place mutation (eager only) ------------------------------------
+    def _mutate(self, new_array):
+        """THE in-place mutation point: every op that overwrites the
+        stored value routes here so the version counter (checked at
+        backward against tape snapshots) can never be skipped."""
+        self._array = new_array
+        self._version += 1
+
     def set_value(self, value):
         if isinstance(value, Tensor):
             arr = value._array
         else:
             arr = jnp.asarray(np.asarray(value))
-        self._array = arr.astype(self._array.dtype).reshape(self._array.shape)
-        self._version += 1
+        self._mutate(arr.astype(self._array.dtype).reshape(self._array.shape))
 
     def copy_(self, other, blocking=True):
         self.set_value(other)
@@ -228,8 +252,7 @@ class Tensor:
     def _in_place_update(self, new_array):
         """Optimizer-style parameter update; keeps identity and autograd
         leaf status. Old buffer is donated conceptually (PJRT frees it)."""
-        self._array = new_array
-        self._version += 1
+        self._mutate(new_array)
 
     # -- iteration / indexing installed by ops package ---------------------
     def __iter__(self):

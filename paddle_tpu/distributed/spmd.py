@@ -128,11 +128,10 @@ class DistributedTrainStep:
         params = self._params
         hcg = self.hcg
         mesh = hcg.mesh
+        from paddle_tpu.jit.api import build_step_fn
+
         opt._ensure_state()
         accum_names = list(opt._accumulators.keys())
-        single_update = opt._single_update
-        extras_list = [opt._per_param_extras(j) for j in self._acc_idx]
-        grad_clip = opt._grad_clip
         pspecs, param_shardings = self._param_shardings()
         acc_shardings = {
             k: [NamedSharding(mesh, accum_pspec(pspecs[i], params[i], hcg,
@@ -140,45 +139,13 @@ class DistributedTrainStep:
                 for i in range(len(params))]
             for k in accum_names
         }
-        batch_spec = P(self.batch_axes)
-        batch_sharding = NamedSharding(mesh, batch_spec)
         repl = NamedSharding(mesh, P())
-        from paddle_tpu.core import random as random_mod
 
-        def forward_loss(param_arrays, inputs, label, rng):
-            originals = [p._array for p in params]
-            try:
-                for p, a in zip(params, param_arrays):
-                    p._array = a
-                with random_mod.key_scope(rng):
-                    out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
-                    loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
-                return loss._array if isinstance(loss, Tensor) else loss
-            finally:
-                for p, o in zip(params, originals):
-                    p._array = o
-
-        def step_fn(param_arrays, accums, lr, step, inputs, label, rng):
-            loss, grads = jax.value_and_grad(forward_loss)(
-                param_arrays, inputs, label, rng)
-            if grad_clip is not None:
-                # norms reduce over logical global arrays: XLA inserts the
-                # cross-mesh collectives (hybrid_parallel_optimizer.py:186)
-                grads = grad_clip._clip_arrays(list(grads))
-            new_params, new_accums = [], {k: [] for k in accum_names}
-            for i, (p, g) in enumerate(zip(param_arrays, grads)):
-                acc_i = {k: accums[k][i] for k in accum_names}
-                np_, na = single_update(p, g, acc_i, lr, step,
-                                        extras=extras_list[i])
-                new_params.append(np_)
-                for k in accum_names:
-                    new_accums[k].append(na.get(k, acc_i[k]))
-            return loss, new_params, new_accums
+        step_fn = build_step_fn(model, opt, loss_fn, params, self._acc_idx)
 
         # input shardings are taken from the committed arrays (params/accums
         # are device_put by place_params, the batch by __call__); pinning
         # out_shardings keeps params/opt-state sharded across steps.
-        del batch_sharding
         out_shardings = (
             repl,
             param_shardings,

@@ -305,8 +305,7 @@ def setitem(x, item, value):
     ) and jnp.issubdtype(x._array.dtype, jnp.inexact)
 
     if not needs_grad:
-        x._array = _set(x._array, v)
-        x._version += 1
+        x._mutate(_set(x._array, v))
         return x
 
     if x._creator is None and not x.stop_gradient:
@@ -324,13 +323,10 @@ def setitem(x, item, value):
                     lambda a, vv: _set(a, vv.astype(a.dtype)), old, value)
     else:
         new = apply("setitem", lambda a: _set(a, v), old)
-    x._array = new._array
+    x._mutate(new._array)
     x._creator = new._creator
     x._out_idx = new._out_idx
     x.stop_gradient = new.stop_gradient
-    # invalidate nodes that saved x BEFORE the mutation: their cotangent
-    # would otherwise route through the new creator (wrong values)
-    x._version += 1
     return x
 
 

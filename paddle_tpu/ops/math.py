@@ -200,7 +200,7 @@ def cast(x, dtype):
 
 
 def increment(x, value=1.0):
-    x._array = x._array + value
+    x._mutate(x._array + value)
     return x
 
 

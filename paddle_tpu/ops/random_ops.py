@@ -130,12 +130,12 @@ def shuffle(x, axis=0):
 
 
 def uniform_(x, min=-1.0, max=1.0):
-    x._array = jax.random.uniform(
+    x._mutate(jax.random.uniform(
         next_key(), x._array.shape, x._array.dtype, minval=min, maxval=max
-    )
+    ))
     return x
 
 
 def normal_(x, mean=0.0, std=1.0):
-    x._array = mean + std * jax.random.normal(next_key(), x._array.shape, x._array.dtype)
+    x._mutate(mean + std * jax.random.normal(next_key(), x._array.shape, x._array.dtype))
     return x

@@ -1,0 +1,204 @@
+"""Tests for VERDICT r1 items: to_static stale params (weak #1), PyLayer
+custom autograd (missing #7), leaf register_hook (weak #8)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.jit as jit
+from paddle_tpu.autograd import PyLayer
+
+
+# -- to_static live params ---------------------------------------------------
+
+def test_to_static_sees_param_updates():
+    """Regression for VERDICT weak #1: to_static over a Layer must read
+    LIVE weights, not trace-time constants."""
+    paddle.seed(0)
+    layer = nn.Linear(4, 3)
+    layer = jit.to_static(layer)
+    x = paddle.randn([2, 4])
+    out1 = layer(x).numpy()
+    # mutate the weight and re-run: output must change
+    layer.weight.set_value(layer.weight.numpy() * 2.0)
+    out2 = layer(x).numpy()
+    assert not np.allclose(out1, out2), "to_static baked stale weights"
+
+
+def test_to_static_forward_optstep_forward_matches_eager():
+    """to_static forward -> opt.step() -> forward == eager sequence."""
+    def run(static):
+        paddle.seed(1)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        if static:
+            m = jit.to_static(m)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        x = paddle.to_tensor(np.full((2, 4), 0.5, np.float32))
+        y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        _ = m(x)
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return m(x).numpy()
+
+    np.testing.assert_allclose(run(False), run(True), atol=1e-6)
+
+
+def test_to_static_bound_method():
+    """Decorating a bound forward method also threads live params."""
+    paddle.seed(2)
+    layer = nn.Linear(3, 3)
+    fwd = jit.to_static(layer.forward)
+    x = paddle.randn([2, 3])
+    out1 = fwd(x).numpy()
+    layer.weight.set_value(np.zeros_like(layer.weight.numpy()))
+    out2 = fwd(x).numpy()
+    np.testing.assert_allclose(out2, np.broadcast_to(layer.bias.numpy(), out2.shape),
+                               atol=1e-6)
+    assert not np.allclose(out1, out2)
+
+
+# -- PyLayer -----------------------------------------------------------------
+
+def test_pylayer_custom_tanh_grad():
+    class cus_tanh(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = paddle.tanh(x)
+            ctx.save_for_backward(y)
+            return y
+
+        @staticmethod
+        def backward(ctx, dy):
+            (y,) = ctx.saved_tensor()
+            return dy * (1 - paddle.square(y))
+
+    x = paddle.to_tensor(np.array([0.3, -0.7, 1.2], np.float32))
+    x.stop_gradient = False
+    out = cus_tanh.apply(x)
+    out.sum().backward()
+    expect = 1 - np.tanh(x.numpy()) ** 2
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-6)
+
+
+def test_pylayer_double_linear_matches_analytic():
+    """PyLayer computing w*x with custom backward; composition through
+    surrounding tape ops must match analytic grads."""
+    class scale_op(PyLayer):
+        @staticmethod
+        def forward(ctx, x, w):
+            ctx.save_for_backward(x, w)
+            return x * w
+
+        @staticmethod
+        def backward(ctx, dy):
+            x, w = ctx.saved_tensor()
+            return dy * w, dy * x
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    w = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    x.stop_gradient = False
+    w.stop_gradient = False
+    y = scale_op.apply(x * 2.0, w)  # y = 2x * w
+    (y * y).sum().backward()        # d/dx = 2y*2w = 8xw^2 ; d/dw = 2y*2x=8x^2 w
+    np.testing.assert_allclose(x.grad.numpy(), 8 * x.numpy() * w.numpy() ** 2,
+                               rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), 8 * x.numpy() ** 2 * w.numpy(),
+                               rtol=1e-5)
+
+
+def test_pylayer_multiple_outputs():
+    class split2(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2.0, x * 3.0
+
+        @staticmethod
+        def backward(ctx, d1, d2):
+            return d1 * 2.0 + d2 * 3.0
+
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    x.stop_gradient = False
+    a, b = split2.apply(x)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+
+def test_pylayer_no_grad_passthrough():
+    class ident(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x + 1.0
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))  # stop_gradient=True
+    out = ident.apply(x)
+    assert out.stop_gradient
+
+
+# -- leaf hooks --------------------------------------------------------------
+
+def test_leaf_register_hook_fires_and_modifies():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    x.stop_gradient = False
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 10.0
+
+    h = x.register_hook(hook)
+    (x * 3.0).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [30.0, 30.0])
+
+    # remove: next backward unmodified
+    h.remove()
+    x.clear_grad()
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    assert len(seen) == 1
+
+
+def test_leaf_hook_fires_once_with_accumulated_grad():
+    """A leaf used by several ops gets ONE hook call with the summed
+    gradient (GradNodeAccumulation semantics), not one per contribution."""
+    w = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    w.stop_gradient = False
+    calls = []
+    w.register_hook(lambda g: calls.append(g.numpy().copy()))
+    ((w * 2.0).sum() + (w * 3.0).sum()).backward()
+    assert len(calls) == 1, f"hook fired {len(calls)} times"
+    np.testing.assert_allclose(calls[0], [5.0, 5.0])
+
+
+def test_to_static_retraces_on_param_replacement():
+    """Layer surgery replacing a Parameter object must retrace, not bind
+    into the dead object."""
+    paddle.seed(4)
+    layer = nn.Linear(3, 2)
+    slayer = jit.to_static(layer)
+    x = paddle.to_tensor(np.ones((1, 3), np.float32))
+    _ = slayer(x)
+    import paddle_tpu.core.tensor as T
+    new_w = T.Parameter(np.zeros((3, 2), np.float32))
+    layer.weight = new_w
+    out = slayer(x).numpy()
+    np.testing.assert_allclose(out, np.broadcast_to(layer.bias.numpy(), out.shape),
+                               atol=1e-6)
+
+
+def test_intermediate_register_hook_still_works():
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    x.stop_gradient = False
+    y = x * 4.0
+    y.register_hook(lambda g: g * 0.5)
+    (y * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # 3 * 0.5 * 4

@@ -7,7 +7,14 @@ paddle-parity eager API is kept as a thin façade.
 """
 from jax.sharding import PartitionSpec
 
-from . import fleet, functional, moe, mp_layers, ring_attention, sharding
+from . import fleet, functional, moe, mp_layers, pipeline, ring_attention, sharding
+from .pipeline import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineStack,
+    SegmentLayers,
+    SharedLayerDesc,
+)
 from .mp_layers import (
     ColumnParallelLinear,
     ParallelCrossEntropy,

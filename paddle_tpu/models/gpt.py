@@ -24,6 +24,15 @@ import paddle_tpu.nn.functional as F
 from paddle_tpu.ops import manipulation as mp
 
 
+def _mp_degree():
+    from paddle_tpu.distributed.topology import get_hybrid_communicate_group
+
+    try:
+        return get_hybrid_communicate_group().axis_size("mp")
+    except Exception:
+        return 1
+
+
 @dataclass
 class GPTConfig:
     vocab_size: int = 50304
@@ -76,6 +85,15 @@ class GPTAttention(nn.Layer):
                                   weight_attr=nn.ParamAttr(initializer=init),
                                   bias_attr=bias_attr)
         self.dropout = config.dropout
+        # Megatron tensor-parallel shardings when an mp axis is active:
+        # qkv column-parallel, out row-parallel (mp_layers.py pattern)
+        from jax.sharding import PartitionSpec as P
+
+        if _mp_degree() > 1 and config.hidden_size % _mp_degree() == 0:
+            self.qkv_proj.weight.dist_spec = P(None, "mp")
+            if self.qkv_proj.bias is not None:
+                self.qkv_proj.bias.dist_spec = P("mp")
+            self.out_proj.weight.dist_spec = P("mp", None)
 
     def forward(self, x, cache=None):
         B, S, H = x.shape
@@ -110,6 +128,13 @@ class GPTMLP(nn.Layer):
                              weight_attr=nn.ParamAttr(initializer=out_init),
                              bias_attr=bias_attr)
         self.dropout = nn.Dropout(config.dropout)
+        from jax.sharding import PartitionSpec as P
+
+        if _mp_degree() > 1 and config.intermediate_size % _mp_degree() == 0:
+            self.fc1.weight.dist_spec = P(None, "mp")
+            if self.fc1.bias is not None:
+                self.fc1.bias.dist_spec = P("mp")
+            self.fc2.weight.dist_spec = P("mp", None)
 
     def forward(self, x):
         return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
@@ -195,3 +220,65 @@ class GPTForCausalLM(nn.Layer):
         n = self.num_params()
         s = seq_len or c.max_seq_len
         return 6 * n + 12 * c.num_layers * c.hidden_size * s
+
+
+class GPTEmbeddingPipe(nn.Layer):
+    """Embedding stage for the pipelined GPT (pp_layers.py SharedLayerDesc
+    pattern: the same instance serves as the tied LM head)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wpe = nn.Embedding(config.max_seq_len, config.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(config.dropout)
+        from jax.sharding import PartitionSpec as P
+
+        if _mp_degree() > 1 and config.vocab_size % _mp_degree() == 0:
+            # vocab-parallel embedding (VocabParallelEmbedding analog)
+            self.wte.weight.dist_spec = P("mp", None)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        pos = paddle.arange(S, dtype="int32")
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+def _gpt_head_fwd(embed_layer: "GPTEmbeddingPipe", x):
+    # tied projection: [B,S,H] @ wte^T
+    return paddle.matmul(x, embed_layer.wte.weight, transpose_y=True)
+
+
+class GPTFinalNorm(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, x):
+        return self.ln_f(x)
+
+
+def build_pipeline_gpt(config: GPTConfig, num_stages: int,
+                       num_microbatches: int = None,
+                       recompute_interval: int = 0):
+    """GPT as a PipelineLayer: tied embedding/head via SharedLayerDesc,
+    the block stack stage-stacked over the 'pp' mesh axis. The analog of
+    the reference's GPTForPretrainingPipe-style models driven by
+    hybrid_parallel_pp_transformer.py tests."""
+    from paddle_tpu.distributed import (LayerDesc, PipelineLayer,
+                                        SharedLayerDesc)
+
+    descs = [
+        SharedLayerDesc("gpt_embed", GPTEmbeddingPipe, None, "wte.weight",
+                        config),
+        *[LayerDesc(GPTBlock, config) for _ in range(config.num_layers)],
+        LayerDesc(GPTFinalNorm, config),
+        SharedLayerDesc("gpt_embed", GPTEmbeddingPipe, _gpt_head_fwd,
+                        "wte.weight", config),
+    ]
+    return PipelineLayer(descs, num_stages=num_stages,
+                         num_microbatches=num_microbatches,
+                         recompute_interval=recompute_interval)

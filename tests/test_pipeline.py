@@ -1,0 +1,190 @@
+"""Pipeline-parallel tests (VERDICT r1 missing #1). Runs on the 8-device
+virtual CPU mesh from conftest. The SPMD shift-register schedule must be
+numerically identical to running the same stacked layers sequentially."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import (
+    DistributedTrainStep,
+    LayerDesc,
+    PipelineLayer,
+    PipelineStack,
+    SegmentLayers,
+    SharedLayerDesc,
+)
+from paddle_tpu.distributed.topology import (
+    HybridCommunicateGroup,
+    set_hybrid_communicate_group,
+)
+
+
+class Block(nn.Layer):
+    def __init__(self, hidden):
+        super().__init__()
+        self.ln = nn.LayerNorm(hidden)
+        self.fc1 = nn.Linear(hidden, hidden * 2)
+        self.fc2 = nn.Linear(hidden * 2, hidden)
+
+    def forward(self, x):
+        return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+
+class Embed(nn.Layer):
+    def __init__(self, vocab, hidden):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, hidden)
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class Head(nn.Layer):
+    def __init__(self, hidden, vocab):
+        super().__init__()
+        self.proj = nn.Linear(hidden, vocab)
+
+    def forward(self, x):
+        return self.proj(x)
+
+
+def _mk_model(pp, seed=0):
+    paddle.seed(seed)
+    set_hybrid_communicate_group(HybridCommunicateGroup(pp=pp))
+    descs = [
+        LayerDesc(Embed, 64, 16),
+        *[LayerDesc(Block, 16) for _ in range(4)],
+        LayerDesc(Head, 16, 64),
+    ]
+    return PipelineLayer(descs, num_stages=pp, num_microbatches=4)
+
+
+def test_segment_layers_uniform():
+    assert SegmentLayers.uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert SegmentLayers.uniform(10, 4) == [0, 3, 6, 8, 10]
+
+
+def test_pipeline_forward_parity_pp4_vs_sequential():
+    """Same weights: pipelined execution == sequential execution."""
+    model = _mk_model(pp=4)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 64, (8, 12), np.int32))
+    out_pipe = model(ids).numpy()
+
+    # rerun the stack sequentially with the same weights
+    h = model.pre_layers[0](ids)
+    h_seq = model.stack(h, pipelined=False)
+    for layer, ffn in model._post:
+        h_seq = ffn(layer, h_seq) if ffn is not None else layer(h_seq)
+    np.testing.assert_allclose(out_pipe, h_seq.numpy(), atol=1e-4)
+
+
+def test_pipeline_train_parity_vs_single_device():
+    """pp=2 training loss curve matches the identical model trained with
+    pp=1 (sequential) — same seed => same stacked init."""
+    def run(pp, steps=4):
+        model = _mk_model(pp=pp, seed=3)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        step = DistributedTrainStep(
+            model, opt,
+            lambda out, lab: F.cross_entropy(
+                out.reshape([-1, 64]), lab.reshape([-1])))
+        rng = np.random.RandomState(7)
+        losses = []
+        for _ in range(steps):
+            ids = paddle.to_tensor(rng.randint(0, 64, (8, 12), np.int32))
+            losses.append(float(step(ids, ids)))
+        return losses
+
+    l1 = run(1)
+    l2 = run(2)
+    np.testing.assert_allclose(l1, l2, rtol=2e-3)
+
+
+def test_pipeline_microbatch_counts():
+    """M != S still correct (more microbatches than stages)."""
+    model = _mk_model(pp=2)
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 64, (8, 12), np.int32))
+    out_m4 = model(ids, num_microbatches=4).numpy()
+    out_m2 = model(ids, num_microbatches=2).numpy()
+    h = model.pre_layers[0](ids)
+    ref = model.stack(h, pipelined=False)
+    for layer, ffn in model._post:
+        ref = ffn(layer, ref) if ffn is not None else layer(ref)
+    np.testing.assert_allclose(out_m4, ref.numpy(), atol=1e-4)
+    np.testing.assert_allclose(out_m2, ref.numpy(), atol=1e-4)
+
+
+def test_shared_layer_desc_ties_weights():
+    """SharedLayerDesc with the same key shares ONE layer instance."""
+    paddle.seed(0)
+    set_hybrid_communicate_group(HybridCommunicateGroup(pp=2))
+
+    def head_fwd(layer, x):
+        return paddle.matmul(x, layer.emb.weight, transpose_y=True)
+
+    descs = [
+        SharedLayerDesc("embed", Embed, None, "weight", 64, 16),
+        *[LayerDesc(Block, 16) for _ in range(4)],
+        SharedLayerDesc("embed", Embed, head_fwd, "weight", 64, 16),
+    ]
+    model = PipelineLayer(descs, num_stages=2, num_microbatches=2)
+    # only one embedding parameter set exists
+    emb_params = [p for p in model.parameters()
+                  if p._array.shape == (64, 16)]
+    assert len(emb_params) == 1
+    ids = paddle.to_tensor(np.arange(24, dtype=np.int32).reshape(2, 12))
+    out = model(ids)
+    assert list(out.shape) == [2, 12, 64]
+
+
+def test_pipeline_recompute_interval():
+    paddle.seed(5)
+    set_hybrid_communicate_group(HybridCommunicateGroup(pp=2))
+    descs = [LayerDesc(Block, 16) for _ in range(4)]
+    m_plain = PipelineLayer(descs, num_stages=2, num_microbatches=2)
+    paddle.seed(5)
+    m_ck = PipelineLayer(descs, num_stages=2, num_microbatches=2,
+                         recompute_interval=1)
+    x = paddle.randn([4, 8, 16])
+    np.testing.assert_allclose(m_plain(x).numpy(), m_ck(x).numpy(),
+                               atol=1e-5)
+
+
+def test_pipeline_gpt_trains_mp2_pp2_sharding2():
+    """The flagship hybrid config (BASELINE GPT mp2/pp2/sharding2) builds,
+    compiles and decreases loss on the virtual 8-device mesh."""
+    from paddle_tpu.models import GPTConfig
+    from paddle_tpu.models.gpt import build_pipeline_gpt
+
+    paddle.seed(0)
+    set_hybrid_communicate_group(
+        HybridCommunicateGroup(dp=1, mp=2, pp=2, sharding=2))
+    cfg = GPTConfig.tiny(vocab=128, hidden=32, layers=4, heads=4, seq=16)
+    model = build_pipeline_gpt(cfg, num_stages=2, num_microbatches=2)
+    model.eval()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+    step = DistributedTrainStep(
+        model, opt,
+        lambda out, lab: F.cross_entropy(
+            out.reshape([-1, cfg.vocab_size]), lab.reshape([-1])),
+        sharding_stage=2, batch_axes=("dp", "sharding"))
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (4, 16), np.int32))
+    losses = [float(step(ids, ids)) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+    # tied embedding: exactly one (vocab, hidden) param
+    tied = [p for p in model.parameters()
+            if tuple(p._array.shape) == (128, 32)]
+    assert len(tied) == 1
+
+
+def test_pipeline_stack_params_sharded_over_pp():
+    model = _mk_model(pp=2)
+    for p in model.stack._stacked:
+        assert p.dist_spec is not None and tuple(p.dist_spec)[0] == "pp"

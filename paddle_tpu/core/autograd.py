@@ -89,8 +89,15 @@ class Node:
         self.inputs = list(inputs)
         # version snapshot: detects in-place mutation (setitem/set_value/
         # optimizer update) between forward record and backward — the
-        # analog of torch/paddle's saved-tensor version counter
-        self.input_versions = [getattr(t, "_version", 0) for t in inputs]
+        # analog of torch/paddle's saved-tensor version counter. Inputs
+        # with stop_gradient=True are not tracked: mutating them cannot
+        # change any gradient this engine computes (vjp closures capture
+        # the pre-mutation arrays), and torch/paddle do not track
+        # non-requires-grad tensors either.
+        self.input_versions = [
+            None if getattr(t, "stop_gradient", True)
+            else getattr(t, "_version", 0)
+            for t in inputs]
         self.out_specs = out_specs
         self.out_cts: List[Optional[object]] = [None] * len(out_specs)
         self.hooks: List[Callable] = []
@@ -202,12 +209,13 @@ def run_backward(tensors, grad_tensors=None, retain_graph: bool = False,
             if all(ct is None for ct in node.out_cts):
                 continue  # branch never contributed to the loss
             for t, ver in zip(node.inputs, node.input_versions):
-                if getattr(t, "_version", 0) != ver:
+                if ver is not None and getattr(t, "_version", 0) != ver:
                     raise RuntimeError(
-                        f"a tensor saved for backward of '{node.name}' was "
-                        f"mutated in place (version {ver} -> {t._version}) "
-                        f"after being used in the forward pass; gradients "
-                        f"through the pre-mutation value would be wrong")
+                        f"a tensor used by '{node.name}' was mutated in "
+                        f"place (version {ver} -> {t._version}) after the "
+                        f"forward pass; backward would silently use the "
+                        f"pre-mutation value (torch/paddle version-counter "
+                        f"semantics forbid this)")
             cts = node.materialized_cts()
             in_cts = node.vjp_fn(cts)
             for hook in node.hooks:

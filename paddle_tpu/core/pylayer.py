@@ -22,6 +22,7 @@ user-defined `backward` with the output cotangents.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .autograd import Node, is_grad_enabled, no_grad
@@ -86,6 +87,14 @@ class PyLayer(metaclass=PyLayerMeta):
             not t.stop_gradient and jnp.issubdtype(t._array.dtype, jnp.inexact)
             for t in tensor_inputs)
 
+        if needs_grad and any(
+                isinstance(t._array, jax.core.Tracer) for t in tensor_inputs):
+            # Inside an outer jax trace (TrainStep, to_static, vmap): the
+            # outer AD would differentiate the forward ops directly and
+            # silently skip the user backward. Route through jax.custom_vjp
+            # so the custom gradient survives tracing.
+            return cls._apply_traced(args, kwargs, tensor_inputs)
+
         # ops inside forward are NOT recorded — the PyLayer node replaces
         # them (py_layer_node.h semantics)
         with no_grad():
@@ -132,6 +141,130 @@ class PyLayer(metaclass=PyLayerMeta):
                 idx += 1
             else:
                 rewrapped.append(o)
+        return rewrapped[0] if single else tuple(rewrapped)
+
+    @classmethod
+    def _normalize_grads(cls, gin, tensor_inputs, diff_mask):
+        """Map the user backward's return to one cotangent per tensor
+        input (paddle contract: one grad per differentiable input in
+        order, or one per tensor input with None holes)."""
+        gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+        n_diff = sum(diff_mask)
+        if len(gin) == len(tensor_inputs):
+            pass  # already aligned with all tensor inputs
+        elif len(gin) == n_diff:
+            full, it = [], iter(gin)
+            for m in diff_mask:
+                full.append(next(it) if m else None)
+            gin = full
+        else:
+            raise RuntimeError(
+                f"{cls.__name__}.backward returned {len(gin)} grads for "
+                f"{n_diff} differentiable inputs")
+        return gin
+
+    @classmethod
+    def _apply_traced(cls, args, kwargs, tensor_inputs):
+        """custom_vjp path used when inputs hold jax tracers.
+
+        All tensor inputs become primal arguments (closing over tracers in
+        a custom_vjp primal is disallowed by jax); saved-for-backward
+        tensors travel as custom_vjp residuals; non-array ctx state rides
+        a Python cell captured at trace time.
+        """
+        diff_mask = [not t.stop_gradient
+                     and jnp.issubdtype(t._array.dtype, jnp.inexact)
+                     for t in tensor_inputs]
+        in_arrays = tuple(t._array for t in tensor_inputs)
+        index_of = {id(t): i for i, t in enumerate(tensor_inputs)}
+        # (ctx, single, is_tensor_mask, non_tensor_outputs) from the most
+        # recent forward trace
+        cell = []
+
+        def _rebuild(obj, arrays):
+            if isinstance(obj, Tensor):
+                i = index_of.get(id(obj))
+                if i is None:
+                    return obj
+                nt = Tensor._wrap(arrays[i],
+                                  stop_gradient=obj.stop_gradient)
+                return nt
+            if isinstance(obj, tuple):
+                return tuple(_rebuild(o, arrays) for o in obj)
+            if isinstance(obj, list):
+                return [_rebuild(o, arrays) for o in obj]
+            return obj
+
+        def _fwd_impl(arrays):
+            fctx = PyLayerContext()
+            new_args = tuple(_rebuild(a, arrays) for a in args)
+            new_kwargs = {k: _rebuild(v, arrays) for k, v in kwargs.items()}
+            with no_grad():
+                outs = cls.forward(fctx, *new_args, **new_kwargs)
+            single = not isinstance(outs, (tuple, list))
+            out_list = [outs] if single else list(outs)
+            mask = [isinstance(o, Tensor) for o in out_list]
+            non_tensor = [o for o in out_list if not isinstance(o, Tensor)]
+            cell.clear()
+            cell.append((fctx, single, mask, non_tensor))
+            out_arrays = tuple(o._array for o in out_list
+                               if isinstance(o, Tensor))
+            saved = tuple(s._array if isinstance(s, Tensor) else jnp.asarray(s)
+                          for s in fctx._saved)
+            return out_arrays, saved
+
+        def _prim(*arrays):
+            return _fwd_impl(arrays)[0]
+
+        def _prim_fwd(*arrays):
+            return _fwd_impl(arrays)
+
+        def _prim_bwd(saved, cts):
+            fctx = cell[0][0] if cell else PyLayerContext()
+            fctx._saved = tuple(Tensor._wrap(s) for s in saved)
+            ct_tensors = [Tensor._wrap(c) for c in cts]
+            with no_grad():
+                gin = cls.backward(fctx, *ct_tensors)
+            gin = cls._normalize_grads(gin, tensor_inputs, diff_mask)
+            import numpy as _np
+            out = []
+            for g, a in zip(gin, in_arrays):
+                if not jnp.issubdtype(a.dtype, jnp.inexact):
+                    # jax's cotangent type for integer/bool primals
+                    out.append(_np.zeros(a.shape, jax.dtypes.float0))
+                elif g is None:
+                    out.append(jnp.zeros(a.shape, a.dtype))
+                else:
+                    out.append(g._array if isinstance(g, Tensor)
+                               else jnp.asarray(g))
+            return tuple(out)
+
+        f = jax.custom_vjp(_prim)
+        f.defvjp(_prim_fwd, _prim_bwd)
+        out_arrays = f(*in_arrays)
+        _, single, mask, non_tensor = cell[0]
+
+        out_specs = [(a.shape, a.dtype) for a in out_arrays]
+        diff_inputs = [t for t, m in zip(tensor_inputs, diff_mask) if m]
+
+        def lazy_vjp(cts, _f=f, _in=in_arrays):
+            ct_list = tuple(cts) if isinstance(cts, (tuple, list)) else (cts,)
+            _, vjp_fn = jax.vjp(_f, *_in)
+            full = vjp_fn(ct_list)
+            return tuple(g for g, m in zip(full, diff_mask) if m)
+
+        node = Node(cls.__name__, lazy_vjp, diff_inputs, out_specs)
+        arr_it = iter(out_arrays)
+        nt_it = iter(non_tensor)
+        rewrapped, idx = [], 0
+        for m in mask:
+            if m:
+                rewrapped.append(Tensor._wrap(next(arr_it),
+                                              stop_gradient=False,
+                                              creator=node, out_idx=idx))
+                idx += 1
+            else:
+                rewrapped.append(next(nt_it))
         return rewrapped[0] if single else tuple(rewrapped)
 
 

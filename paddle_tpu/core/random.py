@@ -91,8 +91,26 @@ def next_key():
     if scope is not None:
         k = jax.random.fold_in(scope[0], scope[1])
         scope[1] += 1
-        return k
-    return _default_generator.next_key()
+    else:
+        k = _default_generator.next_key()
+    # active key folds (e.g. per-slot/per-tick indices inside lax.scan
+    # bodies — traced once, so without the fold every iteration would
+    # reuse one identical key per call site)
+    for f in getattr(_key_scope_tls, "folds", ()):
+        k = jax.random.fold_in(k, f)
+    return k
+
+
+@contextlib.contextmanager
+def fold_key(idx):
+    """Fold `idx` (may be a traced int, e.g. a lax.scan counter) into
+    every key drawn inside the context. Nestable; folds compose."""
+    prev = tuple(getattr(_key_scope_tls, "folds", ()))
+    _key_scope_tls.folds = prev + (idx,)
+    try:
+        yield
+    finally:
+        _key_scope_tls.folds = prev
 
 
 def get_rng_state():

@@ -1,8 +1,14 @@
-"""Benchmark: GPT training throughput on one chip, bf16, fully-compiled
-TrainStep (fwd+bwd+AdamW in a single donated XLA program).
+"""Benchmark suite: training throughput on one chip, bf16, fully-compiled
+TrainStep (fwd+bwd+optimizer in a single donated XLA program).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
 vs_baseline is achieved MFU / 0.45 (the BASELINE.md target MFU).
+
+BENCH_MODEL selects the BASELINE.md row:
+  gpt      (default) GPT-3 1.3B class, tokens/s/chip      — row 3
+  bert     BERT-base seq-512 fine-tune, tokens/s/chip      — row 2
+  resnet50 ResNet-50 @224 synthetic data, images/s/chip    — row 1
+Run all three: for m in gpt bert resnet50; do BENCH_MODEL=$m python bench.py; done
 """
 from __future__ import annotations
 
@@ -13,76 +19,170 @@ import time
 
 import numpy as np
 
+V5E_PEAK = 197e12  # bf16 FLOP/s per v5e chip
 
-def main():
-    import jax
+# ResNet-50 @224 fwd FLOPs (2*MACs, the torchvision/PaddleClas-quoted
+# 4.1 GFLOPs); training fwd+bwd ~= 3x fwd.
+RESNET50_FWD_FLOPS = 4.09e9
 
+
+def _run_scan_steps(step, xs, ys, steps):
+    """Time `steps` training steps executed as ONE XLA program
+    (lax.scan); returns (dt_seconds, compile_seconds, last_loss)."""
+    t0 = time.time()
+    losses = step.run_scan(xs, ys)
+    np.asarray(losses._array)  # readback: block_until_ready is unreliable through the axon tunnel
+    compile_s = time.time() - t0
+    t1 = time.time()
+    losses = step.run_scan(xs, ys)
+    np.asarray(losses._array)
+    dt = time.time() - t1
+    return dt, compile_s, losses[-1]
+
+
+def _emit(metric, unit, rate, flops_per_unit, on_tpu, extra):
+    """Uniform result row: rate in units/s, MFU vs the BASELINE.md 0.45
+    target on the v5e peak (1e12 nominal peak in CPU smoke mode)."""
+    peak = V5E_PEAK if on_tpu else 1e12
+    mfu = rate * flops_per_unit / peak
+    return {
+        "metric": metric,
+        "value": round(rate, 1),
+        "unit": unit,
+        "vs_baseline": round(mfu / 0.45, 4),
+    }, f"{extra} mfu={mfu:.3f}"
+
+
+def bench_gpt(on_tpu):
     import paddle_tpu as paddle
     import paddle_tpu.jit as jit
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    backend = jax.default_backend()
-    on_tpu = backend in ("tpu", "axon")
-
     if on_tpu:
         # the BASELINE.md flagship: GPT-3 1.3B class. hidden=2048/head_dim=128
-        # saturates the MXU (hidden=768-class matmuls measured at <30% peak on
-        # v5e); batch 2 fits without remat — recompute-free beats every remat
-        # policy measured (0.432 vs 0.382 MFU pure-jax).
+        # saturates the MXU; batch 2 fits without remat — recompute-free
+        # beats every remat policy measured.
         cfg = GPTConfig(vocab_size=32768, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=2048, dropout=0.0)
         batch = int(os.environ.get("BENCH_BATCH", "2"))
         steps = int(os.environ.get("BENCH_STEPS", "10"))
-        peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke mode
         cfg = GPTConfig.tiny(vocab=512, hidden=128, layers=2, heads=4, seq=128)
         batch, steps = 2, 5
-        peak_flops = 1e12
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model.eval()  # no dropout inside compiled step
-    model.to(dtype="bfloat16")  # MXU-native; optimizer keeps fp32 master state
+    model.to(dtype="bfloat16")
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     step = jit.TrainStep(model, opt, model.loss_fn)
 
     seq = cfg.max_seq_len
-
-    # multi-step: the whole timed region is ONE XLA program (lax.scan over
-    # steps) so per-dispatch latency doesn't pollute the measurement
-    ids_stack = paddle.to_tensor(
+    ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (steps, batch, seq), np.int32))
+    dt, compile_s, loss = _run_scan_steps(step, ids, ids, steps)
 
-    t0 = time.time()
-    losses = step.run_scan(ids_stack, ids_stack)  # compile + first run
-    np.asarray(losses._array)  # full readback: block_until_ready is unreliable through the axon tunnel
-    compile_s = time.time() - t0
+    tok_s = batch * seq * steps / dt
+    return _emit(
+        "gpt_1p3b_train_tokens_per_sec_per_chip", "tokens/s", tok_s,
+        model.flops_per_token(seq), on_tpu,
+        f"params={model.num_params()/1e6:.1f}M batch={batch} seq={seq} "
+        f"steps={steps} compile={compile_s:.1f}s step={dt/steps*1000:.1f}ms "
+        f"loss={float(loss):.3f}")
 
-    t1 = time.time()
-    losses = step.run_scan(ids_stack, ids_stack)
-    np.asarray(losses._array)  # full readback: block_until_ready is unreliable through the axon tunnel
-    dt = time.time() - t1
-    loss = losses[-1]
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step * steps / dt
-    # training FLOPs/token: 6N (fwd+bwd params) + attention term
-    n_params = model.num_params()
-    flops_tok = model.flops_per_token(seq)
-    mfu = tok_s * flops_tok / peak_flops
+def bench_bert(on_tpu):
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as jit
+    from paddle_tpu.models import BertConfig, BertForSequenceClassification
 
-    result = {
-        "metric": "gpt_1p3b_train_tokens_per_sec_per_chip",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }
+    if on_tpu:
+        cfg = BertConfig.bert_base()
+        # 64 = the largest power-of-two batch that fits 16G HBM at seq 512
+        batch = int(os.environ.get("BENCH_BATCH", "64"))
+        seq = 512
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+    else:
+        cfg = BertConfig.tiny()
+        batch, seq, steps = 2, 64, 5
+    cfg.hidden_dropout = 0.0
+    cfg.attention_dropout = 0.0
+
+    paddle.seed(0)
+    model = BertForSequenceClassification(cfg)
+    model.eval()
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=2e-5,
+                                 parameters=model.parameters())
+    step = jit.TrainStep(model, opt, model.loss_fn)
+
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (steps, batch, seq), np.int32))
+    labels = paddle.to_tensor(
+        np.random.randint(0, cfg.num_labels, (steps, batch), np.int64))
+    dt, compile_s, loss = _run_scan_steps(step, ids, labels, steps)
+
+    tok_s = batch * seq * steps / dt
+    return _emit(
+        "bert_base_finetune_tokens_per_sec_per_chip", "tokens/s", tok_s,
+        model.flops_per_token(seq), on_tpu,
+        f"params={model.num_params()/1e6:.1f}M batch={batch} seq={seq} "
+        f"steps={steps} compile={compile_s:.1f}s step={dt/steps*1000:.1f}ms "
+        f"loss={float(loss):.3f}")
+
+
+def bench_resnet50(on_tpu):
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        batch = int(os.environ.get("BENCH_BATCH", "128"))
+        size, classes = 224, 1000
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        fwd_flops = RESNET50_FWD_FLOPS
+    else:
+        batch, size, classes, steps = 4, 32, 10, 3
+        fwd_flops = RESNET50_FWD_FLOPS * (32 / 224) ** 2
+
+    paddle.seed(0)
+    model = resnet50(num_classes=classes)
+    model.to(dtype="bfloat16")
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    step = jit.TrainStep(model, opt, F.cross_entropy)
+
+    imgs = paddle.to_tensor(np.random.uniform(
+        -1, 1, (steps, batch, 3, size, size)).astype(np.float32))
+    imgs = imgs.astype("bfloat16")
+    labels = paddle.to_tensor(
+        np.random.randint(0, classes, (steps, batch), np.int64))
+    dt, compile_s, loss = _run_scan_steps(step, imgs, labels, steps)
+
+    imgs_s = batch * steps / dt
+    return _emit(
+        "resnet50_train_images_per_sec_per_chip", "images/s", imgs_s,
+        3 * fwd_flops, on_tpu,
+        f"batch={batch} size={size} steps={steps} compile={compile_s:.1f}s "
+        f"step={dt/steps*1000:.1f}ms loss={float(loss):.3f}")
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    which = os.environ.get("BENCH_MODEL", "gpt")
+    table = {"gpt": bench_gpt, "bert": bench_bert,
+             "resnet50": bench_resnet50}
+    fn = table.get(which)
+    if fn is None:
+        sys.exit(f"unknown BENCH_MODEL={which!r}; valid: {sorted(table)}")
+    result, info = fn(on_tpu)
     print(json.dumps(result))
-    print(f"# backend={backend} params={n_params/1e6:.1f}M batch={batch} "
-          f"seq={seq} steps={steps} compile={compile_s:.1f}s "
-          f"step={dt/steps*1000:.1f}ms mfu={mfu:.3f} loss={float(loss):.3f}",
-          file=sys.stderr)
+    print(f"# backend={backend} {info}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -134,7 +134,7 @@ def main():
             dt = scan_time(body3, (params, ids))
             print(f"model fwd+bwd: {dt*1e3:.1f} ms (ideal ~{ideal*1e3:.0f} ms)")
 
-        if which == "model":
+        if which in ("all", "model"):
             step_fn = build_step_fn(model, opt, model.loss_fn, step._params,
                                     step._acc_idx)
             accums = step._gather_accums()

@@ -1,3 +1,7 @@
+from .bert import (BertConfig, BertForPretraining,
+                   BertForSequenceClassification, BertModel, ErnieModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "BertConfig",
+           "BertModel", "ErnieModel", "BertForSequenceClassification",
+           "BertForPretraining"]

@@ -1,0 +1,272 @@
+"""BERT / ERNIE — the encoder model family for the BASELINE.md row-2
+benchmark (ERNIE-3.0-base / BERT-base fine-tune, tokens/sec/chip).
+
+The reference trains these through PaddleNLP on top of the framework
+(tools/ci_model_benchmark.sh clones PaddleNLP and times BERT); the
+architecture here is the canonical post-LN transformer encoder. ERNIE
+1.0/3.0-base share the BERT compute graph (different vocab/pretraining
+objectives), so `ErnieModel` is a configured `BertModel`.
+
+TPU-native choices: fused QKV (one MXU matmul), flash attention via
+F.scaled_dot_product_attention (bidirectional — pallas kernel, no mask
+materialization), bf16-friendly LayerNorms, static shapes throughout.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import manipulation as mp
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+    num_labels: int = 2
+
+    @staticmethod
+    def bert_base():
+        return BertConfig()
+
+    @staticmethod
+    def bert_large():
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          intermediate_size=4096)
+
+    @staticmethod
+    def ernie_base():
+        # ERNIE-1.0 base: same encoder, 18000-token Chinese vocab
+        return BertConfig(vocab_size=18000)
+
+    @staticmethod
+    def ernie_3_base():
+        # ERNIE-3.0-base-zh: L12 H768 A12, 40000 vocab, seq 512
+        return BertConfig(vocab_size=40000)
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4, seq=64):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=heads,
+                          intermediate_size=4 * hidden,
+                          max_position_embeddings=seq)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.word_embeddings = nn.Embedding(
+            config.vocab_size, config.hidden_size, weight_attr=attr)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=attr)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=attr)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = paddle.arange(S, dtype="int32")
+        h = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids)
+        if token_type_ids is None:
+            # BERT convention: omitted token_type_ids means type 0 — the
+            # type-0 row still participates (and trains)
+            h = h + self.token_type_embeddings.weight[0]
+        else:
+            h = h + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.qkv_proj = nn.Linear(config.hidden_size, 3 * config.hidden_size,
+                                  weight_attr=attr)
+        self.out_proj = nn.Linear(config.hidden_size, config.hidden_size,
+                                  weight_attr=attr)
+        self.dropout_p = config.attention_dropout
+
+    def forward(self, x, attn_mask=None):
+        B, S, H = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = mp.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.dropout_p, training=self.training)
+        return self.out_proj(mp.reshape(out, [B, S, H]))
+
+
+class BertLayer(nn.Layer):
+    """Post-LN encoder block (the original BERT residual order)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.attention = BertSelfAttention(config)
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.fc1 = nn.Linear(config.hidden_size, config.intermediate_size,
+                             weight_attr=attr)
+        self.fc2 = nn.Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=attr)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attention(x, attn_mask)))
+        h = self.fc2(F.gelu(self.fc1(x), approximate=True))
+        return self.ln2(x + self.dropout(h))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size,
+                               weight_attr=nn.ParamAttr(initializer=init))
+
+    def forward(self, hidden):  # [B,S,H] -> [B,H] from the [CLS] position
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig, with_pooler: bool = True):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = nn.LayerList([BertLayer(config)
+                                    for _ in range(config.num_layers)])
+        self.pooler = BertPooler(config) if with_pooler else None
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        """attention_mask: [B,S] with 1 for real tokens, 0 for padding
+        (paddle/HF convention); converted to an additive logit mask."""
+        attn_mask = None
+        if attention_mask is not None:
+            # [B,S] -> additive [B,1,1,S]
+            m = (1.0 - attention_mask.astype("float32")) * -1e30
+            attn_mask = m.reshape([m.shape[0], 1, 1, m.shape[1]])
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        if self.pooler is not None:
+            return h, self.pooler(h)
+        return h
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+
+class ErnieModel(BertModel):
+    """ERNIE shares the BERT encoder graph; pretraining differences
+    (knowledge masking, task embeddings) live in the data pipeline."""
+
+
+class BertForSequenceClassification(nn.Layer):
+    """The fine-tune benchmark head (BASELINE.md row 2)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout)
+        self.classifier = nn.Linear(config.hidden_size, config.num_labels)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+    def loss_fn(self, logits, labels):
+        return F.cross_entropy(logits, labels)
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        """Training FLOPs/token: 6N over MATMUL params only (embedding
+        tables are gathers, and unlike GPT there is no tied vocab
+        projection to re-use them as a matmul), plus the attention
+        score/value matmuls (12*L*H*S, bidirectional)."""
+        c = self.config
+        emb = self.bert.embeddings
+        n_embed = sum(p.size for p in emb.word_embeddings.parameters()) \
+            + sum(p.size for p in emb.position_embeddings.parameters()) \
+            + sum(p.size for p in emb.token_type_embeddings.parameters())
+        n_matmul = self.num_params() - n_embed
+        return 6 * n_matmul + 12 * c.num_layers * c.hidden_size * seq_len
+
+
+class BertPretrainingHeads(nn.Layer):
+    """MLM head (tied decoder) + NSP head."""
+
+    def __init__(self, config: BertConfig, embedding_weight):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size,
+                                   weight_attr=nn.ParamAttr(initializer=init))
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_epsilon)
+        self.decoder_weight = embedding_weight  # tied [V,H]
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True,
+            default_initializer=nn.initializer.Constant(0.0))
+        self.seq_relationship = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, hidden, pooled):
+        h = self.layer_norm(F.gelu(self.transform(hidden), approximate=True))
+        mlm_logits = paddle.matmul(h, self.decoder_weight, transpose_y=True) \
+            + self.decoder_bias
+        nsp_logits = self.seq_relationship(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.heads = BertPretrainingHeads(
+            config, self.bert.embeddings.word_embeddings.weight)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                mlm_labels=None, nsp_labels=None):
+        hidden, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        mlm_logits, nsp_logits = self.heads(hidden, pooled)
+        if mlm_labels is None:
+            return mlm_logits, nsp_logits
+        loss = F.cross_entropy(
+            mp.reshape(mlm_logits, [-1, self.config.vocab_size]),
+            mp.reshape(mlm_labels, [-1]), ignore_index=-100)
+        if nsp_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+        return loss

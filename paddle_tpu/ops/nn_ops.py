@@ -98,11 +98,13 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     def fn(a, w):
         if data_format != "NCHW":
             w = jnp.transpose(w, (2, 3, 1, 0))  # OIHW -> HWIO
+        # no preferred_element_type: the MXU accumulates bf16 convs in
+        # fp32 natively, and an explicit fp32 output breaks the conv
+        # transpose rule under AD (fp32 cotangent vs bf16 weight)
         return jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if jnp.issubdtype(a.dtype, jnp.floating) else None,
         ).astype(a.dtype)
 
     out = apply("conv2d", fn, x, weight)

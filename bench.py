@@ -26,8 +26,8 @@ V5E_PEAK = 197e12  # bf16 FLOP/s per v5e chip
 RESNET50_FWD_FLOPS = 4.09e9
 
 
-def _run_scan_steps(step, xs, ys, steps):
-    """Time `steps` training steps executed as ONE XLA program
+def _run_scan_steps(step, xs, ys):
+    """Time xs.shape[0] training steps executed as ONE XLA program
     (lax.scan); returns (dt_seconds, compile_seconds, last_loss)."""
     t0 = time.time()
     losses = step.run_scan(xs, ys)
@@ -81,7 +81,7 @@ def bench_gpt(on_tpu):
     seq = cfg.max_seq_len
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (steps, batch, seq), np.int32))
-    dt, compile_s, loss = _run_scan_steps(step, ids, ids, steps)
+    dt, compile_s, loss = _run_scan_steps(step, ids, ids)
 
     tok_s = batch * seq * steps / dt
     return _emit(
@@ -121,7 +121,7 @@ def bench_bert(on_tpu):
         np.random.randint(0, cfg.vocab_size, (steps, batch, seq), np.int32))
     labels = paddle.to_tensor(
         np.random.randint(0, cfg.num_labels, (steps, batch), np.int64))
-    dt, compile_s, loss = _run_scan_steps(step, ids, labels, steps)
+    dt, compile_s, loss = _run_scan_steps(step, ids, labels)
 
     tok_s = batch * seq * steps / dt
     return _emit(
@@ -159,7 +159,7 @@ def bench_resnet50(on_tpu):
     imgs = imgs.astype("bfloat16")
     labels = paddle.to_tensor(
         np.random.randint(0, classes, (steps, batch), np.int64))
-    dt, compile_s, loss = _run_scan_steps(step, imgs, labels, steps)
+    dt, compile_s, loss = _run_scan_steps(step, imgs, labels)
 
     imgs_s = batch * steps / dt
     return _emit(

@@ -1,4 +1,5 @@
-from .api import TrainStep, not_to_static, to_static
-from .save_load import load, save
+from .api import InputSpec, TrainStep, not_to_static, to_static
+from .save_load import TranslatedLayer, load, save
 
-__all__ = ["to_static", "not_to_static", "TrainStep", "save", "load"]
+__all__ = ["to_static", "not_to_static", "TrainStep", "InputSpec", "save",
+           "load", "TranslatedLayer"]

@@ -1,9 +1,24 @@
-"""jit.save / jit.load — serialized-model analog.
+"""jit.save / jit.load — the serialized-model + inference-predictor path.
 
-Reference: paddle.jit.save writes ProgramDesc protobuf + params
-(jit/api.py, SURVEY §3.3.6); we serialize StableHLO text for each traced
-concrete function plus a state_dict of weights. Loading returns a
-TranslatedLayer-analog that compiles the StableHLO back through jax.
+Reference analogs:
+- paddle.jit.save writes ProgramDesc protobuf + params (jit/api.py,
+  SURVEY §3.3.6); here `save` writes a portable serialized XLA program
+  (jax.export StableHLO artifact) + a pickled numpy state dict.
+- AnalysisPredictor (paddle/fluid/inference/api/analysis_predictor.h:95)
+  loads a saved model and serves it with no Python source for the
+  original nn.Layer; here `load` deserializes the exported program and
+  returns a callable TranslatedLayer (jit::Layer analog,
+  paddle/fluid/jit/layer.h).
+- convert_to_mixed_precision (inference/analysis/passes/
+  convert_to_mixed_precision.cc) becomes `save(..., convert="bfloat16")`:
+  float params are cast to bf16 and the traced program computes in bf16,
+  with float inputs/outputs cast at the boundary.
+
+Artifacts written at {path}:
+  {path}.pdiparams  pickled numpy state dict (weights)
+  {path}.jaxep      serialized jax.export artifact of fn(params, *ins)
+  {path}.json       metadata: input spec, param names/order, convert mode
+  {path}.mlir       StableHLO text (human-inspectable, not reloaded)
 """
 from __future__ import annotations
 
@@ -18,67 +33,157 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 
 
-def save(layer, path, input_spec=None, **configs):
-    """Serialize layer weights + (if traceable) a StableHLO module.
+def _export_platforms():
+    """Always export for cpu AND tpu: the artifact must be loadable on a
+    TPU serving host even when saved from a CPU-only process (and vice
+    versa for CI). jax.export lowers for both ahead of time."""
+    return ["cpu", "tpu"]
 
-    Writes: {path}.pdiparams (pickled numpy state dict),
-            {path}.json (metadata), {path}.mlir (StableHLO, if input_spec).
+
+def save(layer, path, input_spec=None, convert=None, **configs):
+    """Serialize layer weights + (if input_spec given) an executable
+    exported program.
+
+    convert: None | "bfloat16" — mixed-precision convert at save time:
+    float params are stored and traced in bf16 (float inputs are cast in,
+    float outputs cast back to fp32 at the boundary).
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     from paddle_tpu.nn.layer import Layer
 
-    meta = {"format": "paddle_tpu.jit.v1"}
-    if isinstance(layer, Layer):
-        state = {k: np.asarray(v._array) for k, v in layer.state_dict().items()}
-        with open(path + ".pdiparams", "wb") as f:
-            pickle.dump(state, f)
-        if input_spec is not None:
-            from .api import InputSpec
-
-            params = layer.parameters()
-            param_arrays = [p._array for p in params]
-
-            def pure_fn(param_arrays, *inputs):
-                originals = [p._array for p in params]
-                try:
-                    for p, a in zip(params, param_arrays):
-                        p._array = a
-                    out = layer(*[Tensor._wrap(i) for i in inputs])
-                    return jax.tree_util.tree_map(
-                        lambda t: t._array if isinstance(t, Tensor) else t, out,
-                        is_leaf=lambda t: isinstance(t, Tensor))
-                finally:
-                    for p, o in zip(params, originals):
-                        p._array = o
-
-            example = [
-                jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
-                          dtype=s.dtype if isinstance(s.dtype, str) else "float32")
-                for s in input_spec
-            ]
-            lowered = jax.jit(pure_fn).lower(param_arrays, *example)
-            mlir_text = lowered.as_text(dialect="stablehlo")
-            with open(path + ".mlir", "w") as f:
-                f.write(mlir_text)
-            meta["input_spec"] = [
-                {"shape": list(s.shape), "dtype": str(s.dtype)} for s in input_spec
-            ]
-            meta["has_mlir"] = True
-        with open(path + ".json", "w") as f:
-            json.dump(meta, f)
-    else:
+    if not isinstance(layer, Layer):
         raise TypeError("jit.save expects a Layer")
+
+    def conv_arr(a):
+        a = np.asarray(a)
+        if convert == "bfloat16" and a.dtype in (np.float32, np.float64):
+            return a.astype(jnp.bfloat16)
+        return a
+
+    meta = {"format": "paddle_tpu.jit.v2", "convert": convert}
+    state = {k: conv_arr(v._array) for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+    if input_spec is not None:
+        params = layer.parameters()
+        buffers = list(layer.buffers())
+        all_state = params + buffers
+        # name order for rebinding at load time
+        name_of = {id(v): k for k, v in layer.state_dict().items()}
+        state_names = [name_of.get(id(t)) for t in all_state]
+        if any(n is None for n in state_names):
+            raise ValueError("all parameters/buffers must appear in "
+                             "state_dict() to be exportable")
+
+        # inference program: no dropout, BN in eval mode. Save/restore the
+        # PER-SUBLAYER flags (a frozen-backbone model legitimately mixes
+        # train/eval sublayers) and restore even if export fails.
+        sub_modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
+        layer.eval()
+
+        def pure_fn(state_arrays, *inputs):
+            originals = [t._array for t in all_state]
+            try:
+                for t, a in zip(all_state, state_arrays):
+                    t._array = a
+                ins = []
+                for i in inputs:
+                    if convert == "bfloat16" and jnp.issubdtype(i.dtype, jnp.floating):
+                        i = i.astype(jnp.bfloat16)
+                    ins.append(Tensor._wrap(i))
+                out = layer(*ins)
+
+                def leaf(t):
+                    a = t._array if isinstance(t, Tensor) else t
+                    if convert == "bfloat16" and a.dtype == jnp.bfloat16:
+                        a = a.astype(jnp.float32)
+                    return a
+
+                return jax.tree_util.tree_map(
+                    leaf, out, is_leaf=lambda t: isinstance(t, Tensor))
+            finally:
+                for t, o in zip(all_state, originals):
+                    t._array = o
+
+        state_args = [jnp.asarray(state[n]) for n in state_names]
+        example = [
+            jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
+                      dtype=s.dtype if isinstance(s.dtype, str) else "float32")
+            for s in input_spec
+        ]
+        from jax import export as jax_export
+
+        try:
+            exported = jax_export.export(
+                jax.jit(pure_fn), platforms=_export_platforms())(
+                    state_args, *example)
+        finally:
+            for l, mode in sub_modes:
+                l.training = mode
+        with open(path + ".jaxep", "wb") as f:
+            f.write(exported.serialize())
+        with open(path + ".mlir", "w") as f:
+            # the Exported already holds the StableHLO — no second trace
+            f.write(str(exported.mlir_module()))
+        meta["input_spec"] = [
+            {"shape": list(s.shape), "dtype": str(s.dtype)} for s in input_spec
+        ]
+        meta["state_names"] = state_names
+        meta["has_mlir"] = True
+        meta["platforms"] = _export_platforms()
+
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
 
 
 class TranslatedLayer:
-    """Analog of paddle.jit.TranslatedLayer: a loaded, executable model."""
+    """Loaded, executable model — the TranslatedLayer / C++ jit::Layer /
+    AnalysisPredictor analog. Runs the saved XLA program with the saved
+    weights; no original Python source needed."""
 
-    def __init__(self, path, state):
+    def __init__(self, path, state, meta, exported=None):
         self._path = path
         self._state = state
+        self._meta = meta
+        if exported is not None and "state_names" not in meta:
+            raise ValueError(
+                f"{path}.jaxep found but {path}.json is missing or predates "
+                f"format v2 — copy the full artifact set ({path}.json, "
+                f".jaxep, .pdiparams) or re-save with this version")
+        self._exported = exported
+        if exported is not None:
+            names = meta["state_names"]
+            self._state_args = [jnp.asarray(state[n]) for n in names]
+
+    @property
+    def input_spec(self):
+        return self._meta.get("input_spec")
+
+    def __call__(self, *inputs):
+        if self._exported is None:
+            raise RuntimeError(
+                f"{self._path} was saved without input_spec — no executable "
+                f"program; re-save with jit.save(layer, path, input_spec=[...])")
+        arrs = [i._array if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        out = self._exported.call(self._state_args, *arrs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor._wrap(a) if isinstance(a, jax.Array) else a, out)
+
+    forward = __call__
 
     def state_dict(self):
         return {k: Tensor(v) for k, v in self._state.items()}
+
+    def set_state_dict(self, state_dict):
+        """Swap weights (same shapes/dtypes) without retracing."""
+        for k, v in state_dict.items():
+            a = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+            self._state[k] = np.asarray(a)
+        if self._exported is not None:
+            self._state_args = [jnp.asarray(self._state[n])
+                                for n in self._meta["state_names"]]
 
     def load_into(self, layer):
         layer.set_state_dict(self._state)
@@ -86,6 +191,20 @@ class TranslatedLayer:
 
 
 def load(path, **configs):
+    """Load a saved model. Returns an executable TranslatedLayer when the
+    model was saved with input_spec (deserializes + compiles the exported
+    program); otherwise a weights-only TranslatedLayer usable via
+    load_into()."""
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
-    return TranslatedLayer(path, state)
+    meta = {}
+    if os.path.exists(path + ".json"):
+        with open(path + ".json") as f:
+            meta = json.load(f)
+    exported = None
+    if os.path.exists(path + ".jaxep"):
+        from jax import export as jax_export
+
+        with open(path + ".jaxep", "rb") as f:
+            exported = jax_export.deserialize(f.read())
+    return TranslatedLayer(path, state, meta, exported)

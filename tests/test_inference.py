@@ -1,0 +1,130 @@
+"""Inference path tests — the AnalysisPredictor analog (VERDICT r2 #3).
+
+save → (new process, no model class) → load → infer parity, plus the
+bf16 mixed-precision convert option (reference:
+inference/analysis/passes/convert_to_mixed_precision.cc).
+"""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+import paddle_tpu.nn as nn
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.bn = nn.BatchNorm1D(32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return self.fc2(F.relu(self.bn(self.fc1(x))))
+
+
+def _build_and_save(path, convert=None):
+    paddle.seed(7)
+    net = SmallNet()
+    net.eval()
+    x = paddle.randn([4, 8])
+    ref = net(x)
+    jit.save(net, path, input_spec=[jit.InputSpec([4, 8], "float32")],
+             convert=convert)
+    return np.asarray(x._array), np.asarray(ref._array)
+
+
+def test_save_load_executable_same_process(tmp_path):
+    path = str(tmp_path / "model")
+    x, ref = _build_and_save(path)
+    predictor = jit.load(path)
+    out = predictor(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._array), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_save_load_executable_new_process(tmp_path):
+    """The key predictor property: a fresh process that never imports
+    the model's Python class can load + execute the saved program."""
+    path = str(tmp_path / "model")
+    x, ref = _build_and_save(path)
+    np.save(str(tmp_path / "x.npy"), x)
+    runner = tmp_path / "runner.py"
+    runner.write_text(
+        "import sys, numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.jit as jit\n"
+        "predictor = jit.load(sys.argv[1])\n"
+        "x = np.load(sys.argv[2])\n"
+        "out = predictor(paddle.to_tensor(x))\n"
+        "np.save(sys.argv[3], np.asarray(out._array))\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__)) \
+        + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run(
+        [sys.executable, str(runner), path, str(tmp_path / "x.npy"),
+         str(tmp_path / "out.npy")],
+        check=True, env=env, timeout=300)
+    out = np.load(str(tmp_path / "out.npy"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_save_convert_bf16(tmp_path):
+    path = str(tmp_path / "model_bf16")
+    x, ref = _build_and_save(path, convert="bfloat16")
+    # stored float params are bf16
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    import jax.numpy as jnp
+
+    assert state["fc1.weight"].dtype == jnp.bfloat16
+    meta = json.load(open(path + ".json"))
+    assert meta["convert"] == "bfloat16"
+    predictor = jit.load(path)
+    out = predictor(paddle.to_tensor(x))
+    # fp32 in/out boundary, bf16 compute inside
+    assert "float32" in str(out.dtype) and "bfloat16" not in str(out.dtype)
+    np.testing.assert_allclose(np.asarray(out._array), ref, rtol=0.05,
+                               atol=0.05)
+
+
+def test_weights_only_load_still_works(tmp_path):
+    paddle.seed(1)
+    net = SmallNet()
+    path = str(tmp_path / "weights_only")
+    jit.save(net, path)  # no input_spec
+    loaded = jit.load(path)
+    with pytest.raises(RuntimeError, match="input_spec"):
+        loaded(paddle.randn([4, 8]))
+    net2 = SmallNet()
+    loaded.load_into(net2)
+    x = paddle.randn([4, 8])
+    net.eval(), net2.eval()
+    np.testing.assert_allclose(np.asarray(net(x)._array),
+                               np.asarray(net2(x)._array), rtol=1e-6)
+
+
+def test_predictor_weight_swap(tmp_path):
+    """set_state_dict swaps weights without retracing (zero-copy-ish
+    serving update)."""
+    path = str(tmp_path / "model")
+    x, ref = _build_and_save(path)
+    predictor = jit.load(path)
+    paddle.seed(123)
+    net2 = SmallNet()
+    net2.eval()
+    xt = paddle.to_tensor(x)
+    ref2 = net2(xt)
+    predictor.set_state_dict(net2.state_dict())
+    out2 = predictor(xt)
+    np.testing.assert_allclose(np.asarray(out2._array),
+                               np.asarray(ref2._array), rtol=1e-5, atol=1e-5)

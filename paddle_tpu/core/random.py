@@ -51,7 +51,17 @@ class Generator:
             self._key = jax.random.wrap_key_data(np.asarray(key_data))
 
 
-_default_generator = Generator(0)
+# created lazily: constructing a PRNG key initializes the XLA backend,
+# and `import paddle_tpu` must stay legal BEFORE jax.distributed.initialize
+# (multi-process bootstrap, parallel.py init_parallel_env)
+_default_generator = None
+
+
+def _gen() -> Generator:
+    global _default_generator
+    if _default_generator is None:
+        _default_generator = Generator(0)
+    return _default_generator
 
 # Traced-key scope: inside a compiled step (TrainStep/DistributedTrainStep)
 # the per-step PRNG key is a *traced argument*; random ops must derive from
@@ -78,12 +88,12 @@ def in_key_scope() -> bool:
 
 
 def default_generator() -> Generator:
-    return _default_generator
+    return _gen()
 
 
 def seed(value: int) -> Generator:
     """Analog of paddle.seed: reseeds the global generator."""
-    return _default_generator.manual_seed(value)
+    return _gen().manual_seed(value)
 
 
 def next_key():
@@ -92,7 +102,7 @@ def next_key():
         k = jax.random.fold_in(scope[0], scope[1])
         scope[1] += 1
     else:
-        k = _default_generator.next_key()
+        k = _gen().next_key()
     # active key folds (e.g. per-slot/per-tick indices inside lax.scan
     # bodies — traced once, so without the fold every iteration would
     # reuse one identical key per call site)
@@ -114,8 +124,8 @@ def fold_key(idx):
 
 
 def get_rng_state():
-    return _default_generator.get_state()
+    return _gen().get_state()
 
 
 def set_rng_state(state):
-    _default_generator.set_state(state)
+    _gen().set_state(state)

@@ -11,23 +11,31 @@ Two layers, reflecting the TPU execution model:
    moral equivalent.
 
 2. **Eager host-level collectives** (this module) — the paddle-parity
-   paddle.distributed.all_reduce(tensor) surface. Implemented by staging a
-   tiny shard_map program over the relevant mesh axis on the fly, or a
-   no-op identity when the axis degree is 1 (single process, single
-   device). Asynchronous semantics follow PJRT: dispatch is async, arrays
-   are futures.
+   paddle.distributed.all_reduce(tensor) surface. Each call stages a tiny
+   jitted program over a mesh of one device per participating process:
+   the local tensor becomes one shard of a global array
+   (jax.make_array_from_single_device_arrays), the program reduces /
+   gathers / permutes it, and the replicated (or resharded) output is
+   read back locally. This is the ProcessGroupXLA facade SURVEY §5
+   sketches: multi-controller SPMD, so — exactly like NCCL — every
+   member of the group must call the collective, in the same order.
+
+   With one participant every collective degenerates to the identity
+   (reference semantics for world_size=1).
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
 
-from . import functional as F
 from .topology import get_hybrid_communicate_group
 
 
@@ -39,10 +47,21 @@ class ReduceOp:
     AVG = "avg"
 
 
+_REDUCERS = {
+    ReduceOp.SUM: jnp.sum,
+    ReduceOp.MAX: jnp.max,
+    ReduceOp.MIN: jnp.min,
+    ReduceOp.PROD: jnp.prod,
+    ReduceOp.AVG: jnp.mean,
+}
+
+
 class Group:
     """Communication group — analog of paddle.distributed.collective.Group.
-    TPU-native: identifies a mesh axis (collectives compile onto it), plus
-    rank bookkeeping for API parity."""
+
+    ranks are PROCESS indices (one device per process carries the eager
+    collectives; in-mesh collectives use `axis` instead). A group with an
+    `axis` identifies a mesh axis for the compiled path."""
 
     def __init__(self, ranks: List[int], axis: Optional[str] = None, gid: int = 0):
         self.ranks = ranks
@@ -80,66 +99,224 @@ def get_group(gid=0) -> Optional[Group]:
     return _groups.get(gid)
 
 
-def _axis_degree(group: Optional[Group]) -> int:
-    if group is not None and group.axis is not None:
-        return get_hybrid_communicate_group().axis_size(group.axis)
+# ---------------------------------------------------------------------------
+# eager cross-process machinery
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _proc_device(pid: int):
+    """First device owned by process pid (the rank's collective device —
+    FLAGS_selected_gpus analog)."""
+    for d in jax.devices():
+        if d.process_index == pid:
+            return d
+    raise ValueError(f"no device for process {pid}")
+
+
+@functools.lru_cache(maxsize=None)
+def _group_mesh(ranks: tuple):
+    """1-D mesh over one device per member process. Only member processes
+    launch programs on it (multi-controller SPMD)."""
+    return Mesh(np.array([_proc_device(r) for r in ranks]), ("world",))
+
+
+def _axis_member_ranks(axis: str):
+    """Processes in the caller's slice along `axis` of the hybrid mesh.
+    A mesh-axis group's "ranks" are devices inside compiled programs; the
+    eager host collective over it is only meaningful when each step along
+    the axis is a distinct process."""
+    hcg = get_hybrid_communicate_group()
+    degree = hcg.axis_size(axis)
+    if degree <= 1:
+        return (jax.process_index(),)
+    mesh = hcg.mesh
+    devs = mesh.devices
+    me = jax.process_index()
+    my_coord = None
+    for coord, d in np.ndenumerate(devs):
+        if d.process_index == me:
+            my_coord = coord
+            break
+    if my_coord is None:
+        raise ValueError(f"process {me} owns no device in the hybrid mesh")
+    ax = mesh.axis_names.index(axis)
+    sl = list(my_coord)
+    sl[ax] = slice(None)
+    group_devs = devs[tuple(sl)].ravel()
+    ranks = tuple(sorted({d.process_index for d in group_devs}))
+    if len(ranks) < degree:
+        raise NotImplementedError(
+            f"eager collective over mesh axis {axis!r}: the axis spans "
+            f"devices within one process — use paddle_tpu.distributed."
+            f"functional inside shard_map / DistributedTrainStep "
+            f"(compiled path)")
+    return ranks
+
+
+def _member_ranks(group: Optional[Group]):
+    if group is not None:
+        if group.axis is not None:
+            return _axis_member_ranks(group.axis)
+        return tuple(group.ranks)
     try:
-        return jax.process_count()
+        return tuple(range(jax.process_count()))
     except Exception:
-        return 1
+        return (0,)
 
 
-def _eager_collective(tensor: Tensor, group, per_shard_fn, identity_ok=True):
-    """Run a collective eagerly. With one participant it is the identity
-    (matching reference semantics for world_size=1)."""
-    if _axis_degree(group) <= 1:
-        return tensor
-    raise NotImplementedError(
-        "eager cross-process collectives require the compiled path: wrap "
-        "your step with paddle_tpu.distributed.shard_step or use "
-        "paddle_tpu.distributed.functional inside shard_map")
+def _as_global(arr, mesh):
+    """Local array -> global [P, *shape] array, one shard per process."""
+    me = jax.process_index()
+    sharding = NamedSharding(mesh, P("world"))
+    local = jax.device_put(arr[None], _proc_device(me))
+    P_ = mesh.devices.size
+    return jax.make_array_from_single_device_arrays(
+        (P_,) + tuple(arr.shape), sharding, [local])
 
+
+def _replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+_PROGRAMS = {
+    "identity": lambda g: g,
+    "swap01": lambda g: jnp.swapaxes(g, 0, 1),
+    **{f"reduce_{name}": functools.partial(
+        lambda red, g: red(g, axis=0), red)
+       for name, red in _REDUCERS.items()},
+    **{f"select_{i}": functools.partial(lambda i, g: g[i], i)
+       for i in range(64)},
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_program(kind: str, ranks: tuple):
+    """One compiled program per (collective kind, group) — jax.jit caches
+    on function identity, so per-call lambdas would retrace+recompile on
+    every invocation (hundreds of ms each on TPU)."""
+    mesh = _group_mesh(ranks)
+    return jax.jit(_PROGRAMS[kind], out_shardings=_replicated(mesh))
+
+
+def _run_collective(arr, ranks, kind):
+    """Stage the `kind` program over the group mesh on the stacked global
+    array and return the replicated result (locally addressable)."""
+    mesh = _group_mesh(ranks)
+    g = _as_global(arr, mesh)
+    out = _jitted_program(kind, ranks)(g)
+    # the output is replicated: read this process's local copy
+    return np.asarray(out.addressable_shards[0].data)
+
+
+def _ret(tensor: Tensor, value) -> Tensor:
+    tensor.set_value(jnp.asarray(value, tensor._array.dtype))
+    return tensor
+
+
+# ---------------------------------------------------------------------------
+# the collectives (paddle.distributed.* parity surface)
+# ---------------------------------------------------------------------------
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    return _eager_collective(tensor, group, F.all_reduce)
+    """Reference: distributed/communication/all_reduce.py; ProcessGroup::
+    AllReduce."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
+        return tensor
+    out = _run_collective(tensor._array, ranks, f"reduce_{op}")
+    return _ret(tensor, out)
 
 
 def all_gather(tensor_list, tensor: Tensor, group=None, sync_op=True):
-    if _axis_degree(group) <= 1:
+    """Reference: communication/all_gather.py."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
         tensor_list.append(tensor)
         return tensor_list
-    raise NotImplementedError("see all_reduce note")
+    out = _run_collective(tensor._array, ranks, "identity")
+    for i in range(len(ranks)):
+        tensor_list.append(Tensor._wrap(jnp.asarray(out[i])))
+    return tensor_list
 
 
 def broadcast(tensor: Tensor, src=0, group=None, sync_op=True):
-    return _eager_collective(tensor, group, F.broadcast)
+    """Reference: communication/broadcast.py."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
+        return tensor
+    if src not in ranks:
+        raise ValueError(f"broadcast src={src} is not in group ranks {ranks}")
+    si = ranks.index(src)
+    out = _run_collective(tensor._array, ranks, f"select_{si}")
+    return _ret(tensor, out)
 
 
 def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return _eager_collective(tensor, group, F.all_reduce)
+    """Reference: communication/reduce.py. All members compute the
+    reduction (on TPU the replicated result is free); only dst's tensor
+    is updated, matching the reference's contract that non-dst outputs
+    are unspecified."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
+        return tensor
+    out = _run_collective(tensor._array, ranks, f"reduce_{op}")
+    if jax.process_index() == dst:
+        return _ret(tensor, out)
+    return tensor
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if _axis_degree(group) <= 1:
+    """Reference: communication/scatter.py. src provides tensor_list;
+    every member receives its slot."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
         if tensor_list:
             tensor.set_value(tensor_list[0])
         return tensor
-    raise NotImplementedError("see all_reduce note")
+    me = jax.process_index()
+    if src not in ranks:
+        raise ValueError(f"scatter src={src} is not in group ranks {ranks}")
+    si = ranks.index(src)
+    my = ranks.index(me)
+    if me == src:
+        stacked = jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
+                             for t in tensor_list])
+    else:
+        stacked = jnp.zeros((len(ranks),) + tuple(tensor._array.shape),
+                            tensor._array.dtype)
+    out = _run_collective(stacked, ranks, f"select_{si}")
+    return _ret(tensor, out[my])
 
 
 def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
-    if _axis_degree(group) <= 1:
+    """Reference: communication/all_to_all.py. Each member sends
+    in_tensor_list[j] to member j."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
-    raise NotImplementedError("see all_reduce note")
+    me = ranks.index(jax.process_index())
+    stacked = jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in in_tensor_list])
+    # global [P, P, *s]: row i = process i's send list; my receives = column me
+    out = _run_collective(stacked, ranks, "swap01")
+    for j in range(len(ranks)):
+        out_tensor_list.append(Tensor._wrap(jnp.asarray(out[me][j])))
+    return out_tensor_list
 
 
 def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    if _axis_degree(group) <= 1:
+    """Reference: communication/reduce_scatter.py."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
         tensor.set_value(tensor_list[0])
         return tensor
-    raise NotImplementedError("see all_reduce note")
+    me = ranks.index(jax.process_index())
+    stacked = jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in tensor_list])
+    out = _run_collective(stacked, ranks, f"reduce_{op}")
+    return _ret(tensor, out[me])
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
@@ -153,7 +330,10 @@ def recv(tensor: Tensor, src=0, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    """Host barrier: block until all pending device work completes; with
-    multiple processes PJRT's coordination service sequences program
-    launches, so draining dispatch is the correct analog."""
-    (jnp.zeros(()) + 0).block_until_ready()
+    """Real cross-process barrier: a world all-reduce of a scalar, read
+    back synchronously (every member blocks until all have launched)."""
+    ranks = _member_ranks(group)
+    if len(ranks) <= 1:
+        (jnp.zeros(()) + 0).block_until_ready()
+        return
+    _run_collective(jnp.zeros((), jnp.int32), ranks, "reduce_sum")

@@ -1,0 +1,3 @@
+from .main import build_parser, launch, main
+
+__all__ = ["launch", "main", "build_parser"]

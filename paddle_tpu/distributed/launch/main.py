@@ -1,0 +1,152 @@
+"""Launcher CLI — analog of python/paddle/distributed/launch/main.py and
+controllers/collective.py:21 (CollectiveController).
+
+`python -m paddle_tpu.distributed.launch --nprocs N train.py args...`
+spawns one process per rank on this host with the env contract the
+reference's launcher sets (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_MASTER), plus the JAX coordination-service address consumed by
+init_parallel_env (jax.distributed.initialize — the TCPStore+NCCL-id
+rendezvous analog, process_group_nccl.h:202).
+
+TPU-native differences from the reference:
+- one process per HOST, not per device: a JAX process drives all its
+  local chips, so --nprocs is a host/pod-slice count (on one machine,
+  useful mainly with the CPU backend for tests/CI);
+- no per-device FLAGS_selected_gpus: device visibility is the backend's;
+  with --backend cpu each rank gets --xla_force_host_platform_device_count
+  =devices_per_proc virtual devices (the reference test pattern,
+  SURVEY §4 multi-node-without-a-cluster).
+
+Controller behavior (controllers/controller.py:34 watch loop): streams
+children's output with a rank prefix, waits for completion, and on the
+first failure kills the remaining ranks and exits nonzero.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="spawn a collective job: one process per rank")
+    p.add_argument("--nprocs", "--nnodes", type=int, default=1,
+                   help="number of ranks (processes) to launch")
+    p.add_argument("--master", default=None,
+                   help="coordinator ip:port (default: 127.0.0.1:<free port>)")
+    p.add_argument("--backend", default=None, choices=[None, "cpu", "tpu"],
+                   help="force a jax backend for the ranks (cpu for tests)")
+    p.add_argument("--devices-per-proc", type=int, default=1,
+                   help="virtual device count per rank (cpu backend only)")
+    p.add_argument("--log-dir", default=None,
+                   help="write per-rank logs to files instead of stdout")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def _rank_env(args, rank: int, master: str) -> dict:
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(args.nprocs)
+    env["PADDLE_MASTER"] = master
+    env["MASTER_ADDR"], env["MASTER_PORT"] = master.split(":")
+    if args.backend == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        # a TPU-plugin sitecustomize (if present on PYTHONPATH) must not
+        # grab the backend before jax.distributed.initialize runs in the
+        # rank; plugin registration is keyed off its pool env var
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = env.get("XLA_FLAGS", "")
+        # strip any inherited device-count flag before setting ours
+        flags = " ".join(f for f in flags.split()
+                         if not f.startswith("--xla_force_host_platform_device_count"))
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count="
+                            + str(args.devices_per_proc)).strip()
+    elif args.backend == "tpu":
+        env["JAX_PLATFORMS"] = "tpu"
+    return env
+
+
+def _stream(proc, rank):
+    for line in proc.stdout:
+        sys.stdout.write(f"[rank {rank}] {line.decode(errors='replace')}")
+        sys.stdout.flush()
+
+
+def launch(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    master = args.master or f"127.0.0.1:{_free_port()}"
+
+    procs = []
+    streams = []
+    logs = []
+    for rank in range(args.nprocs):
+        env = _rank_env(args, rank, master)
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            logf = open(os.path.join(args.log_dir, f"rank{rank}.log"), "w")
+            logs.append(logf)
+            proc = subprocess.Popen(
+                [sys.executable, args.script] + args.script_args,
+                env=env, stdout=logf, stderr=subprocess.STDOUT)
+        else:
+            proc = subprocess.Popen(
+                [sys.executable, args.script] + args.script_args,
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            t = threading.Thread(target=_stream, args=(proc, rank))
+            t.daemon = True
+            t.start()
+            streams.append(t)
+        procs.append(proc)
+
+    # watch loop (ControllerBase.watch analog): first failure kills the pod
+    rc = 0
+    try:
+        pending = set(range(args.nprocs))
+        while pending:
+            for i in list(pending):
+                r = procs[i].poll()
+                if r is None:
+                    continue
+                pending.discard(i)
+                if r != 0:
+                    rc = r
+                    for j in pending:
+                        procs[j].send_signal(signal.SIGTERM)
+                    deadline = time.time() + 10
+                    for j in pending:
+                        try:
+                            procs[j].wait(max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            procs[j].kill()
+                    pending.clear()
+                    break
+            time.sleep(0.2)
+    finally:
+        for t in streams:
+            t.join(timeout=5)
+        for f in logs:
+            f.close()
+    return rc
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -107,12 +107,23 @@ def save(layer, path, input_spec=None, convert=None, **configs):
                     t._array = o
 
         state_args = [jnp.asarray(state[n]) for n in state_names]
-        example = [
-            jnp.zeros(tuple(d if d and d > 0 else 1 for d in s.shape),
-                      dtype=s.dtype if isinstance(s.dtype, str) else "float32")
-            for s in input_spec
-        ]
         from jax import export as jax_export
+
+        # None/-1 dims become symbolic (jax.export shape polymorphism):
+        # the loaded predictor then accepts any size there (the dynamic-
+        # batch contract of paddle.static.InputSpec)
+        sym_count = 0
+        example = []
+        for s in input_spec:
+            dims = []
+            for d in s.shape:
+                if d is None or (isinstance(d, int) and d < 0):
+                    dims.append(jax_export.symbolic_shape(f"_b{sym_count}")[0])
+                    sym_count += 1
+                else:
+                    dims.append(d)
+            dt = s.dtype if isinstance(s.dtype, str) else "float32"
+            example.append(jax.ShapeDtypeStruct(tuple(dims), jnp.dtype(dt)))
 
         try:
             exported = jax_export.export(
@@ -177,10 +188,16 @@ class TranslatedLayer:
         return {k: Tensor(v) for k, v in self._state.items()}
 
     def set_state_dict(self, state_dict):
-        """Swap weights (same shapes/dtypes) without retracing."""
+        """Swap weights (same shapes) without retracing. Honors the
+        artifact's convert mode: fp32 weights swapped into a
+        convert="bfloat16" predictor are cast to match the program."""
+        conv = self._meta.get("convert")
         for k, v in state_dict.items():
             a = v._array if isinstance(v, Tensor) else jnp.asarray(v)
-            self._state[k] = np.asarray(a)
+            a = np.asarray(a)
+            if conv == "bfloat16" and a.dtype in (np.float32, np.float64):
+                a = a.astype(jnp.bfloat16)
+            self._state[k] = a
         if self._exported is not None:
             self._state_args = [jnp.asarray(self._state[n])
                                 for n in self._meta["state_names"]]

@@ -1,0 +1,126 @@
+"""Worker script for the 2-process localhost tests (the dist_mnist.py
+analog of test_dist_base.py:899): launched by
+`python -m paddle_tpu.distributed.launch --nprocs 2 --backend cpu`.
+
+Phases:
+  collectives — init_parallel_env, then exercise the five core eager
+      collectives + barrier against numpy expectations;
+  train — DistributedTrainStep dp=2 parity: rank 0 writes per-step
+      losses to OUT_FILE for the parent to compare with its 1-process
+      baseline.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def check(name, got, want):
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6, err_msg=name)
+    print(f"ok {name}", flush=True)
+
+
+def run_collectives(dist, paddle, rank, world):
+    t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+    dist.all_reduce(t)
+    check("all_reduce", t._array, np.full((4,), sum(range(1, world + 1)), np.float32))
+
+    outs = []
+    t = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+    dist.all_gather(outs, t)
+    for j in range(world):
+        check(f"all_gather[{j}]", outs[j]._array, np.full((3,), float(j)))
+
+    t = paddle.to_tensor(np.full((2,), float(rank * 10 + 5), np.float32))
+    dist.broadcast(t, src=1)
+    check("broadcast", t._array, np.full((2,), 15.0))
+
+    t = paddle.to_tensor(np.full((2,), float(rank + 1), np.float32))
+    dist.reduce(t, dst=0, op=dist.ReduceOp.MAX)
+    if rank == 0:
+        check("reduce", t._array, np.full((2,), float(world)))
+
+    # scatter: src=0 provides per-rank rows
+    t = paddle.to_tensor(np.zeros((2,), np.float32))
+    tl = [paddle.to_tensor(np.full((2,), 100.0 + j, np.float32))
+          for j in range(world)] if rank == 0 else None
+    dist.scatter(t, tensor_list=tl, src=0)
+    check("scatter", t._array, np.full((2,), 100.0 + rank))
+
+    # alltoall: rank r sends value r*10+j to rank j
+    ins = [paddle.to_tensor(np.full((2,), float(rank * 10 + j), np.float32))
+           for j in range(world)]
+    outs = []
+    dist.alltoall(ins, outs)
+    for j in range(world):
+        check(f"alltoall[{j}]", outs[j]._array,
+              np.full((2,), float(j * 10 + rank)))
+
+    # reduce_scatter: everyone contributes [world] rows, gets its summed row
+    t = paddle.to_tensor(np.zeros((2,), np.float32))
+    tl = [paddle.to_tensor(np.full((2,), float(rank + j), np.float32))
+          for j in range(world)]
+    dist.reduce_scatter(t, tl)
+    want = sum(r + rank for r in range(world))
+    check("reduce_scatter", t._array, np.full((2,), float(want)))
+
+    dist.barrier()
+    print("ok barrier", flush=True)
+
+
+def run_train(dist, paddle, rank, world, out_file):
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import (DistributedTrainStep,
+                                        HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+
+    hcg = HybridCommunicateGroup(dp=world)
+    set_hybrid_communicate_group(hcg)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters())
+    step = DistributedTrainStep(net, opt, F.cross_entropy, hcg=hcg)
+
+    rng = np.random.RandomState(42)
+    losses = []
+    for _ in range(5):
+        # every rank feeds the identical GLOBAL batch; the step's input
+        # sharding slices out the local dp shard
+        x = rng.uniform(-1, 1, (8, 8)).astype(np.float32)
+        y = rng.randint(0, 4, (8,)).astype(np.int64)
+        loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        losses.append(float(loss))
+    if rank == 0 and out_file:
+        with open(out_file, "w") as f:
+            json.dump(losses, f)
+    print("ok train", losses, flush=True)
+
+
+def main():
+    phase = sys.argv[1] if len(sys.argv) > 1 else "all"
+    out_file = sys.argv[2] if len(sys.argv) > 2 else None
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == int(os.environ["PADDLE_TRAINERS_NUM"]), \
+        f"world={world} env={os.environ['PADDLE_TRAINERS_NUM']}"
+
+    if phase in ("all", "collectives"):
+        run_collectives(dist, paddle, rank, world)
+    if phase in ("all", "train"):
+        run_train(dist, paddle, rank, world, out_file)
+    print("WORKER_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

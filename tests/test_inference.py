@@ -113,6 +113,37 @@ def test_weights_only_load_still_works(tmp_path):
                                np.asarray(net2(x)._array), rtol=1e-6)
 
 
+def test_dynamic_batch_dim(tmp_path):
+    """InputSpec None dims export symbolically: the predictor accepts any
+    batch size (paddle.static.InputSpec dynamic-batch contract)."""
+    paddle.seed(7)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "dyn")
+    jit.save(net, path, input_spec=[jit.InputSpec([None, 8], "float32")])
+    predictor = jit.load(path)
+    for b in (1, 4, 9):
+        x = paddle.randn([b, 8])
+        out = predictor(x)
+        assert out.shape == [b, 4]
+        np.testing.assert_allclose(np.asarray(out._array),
+                                   np.asarray(net(x)._array),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_convert_predictor_weight_swap(tmp_path):
+    """fp32 weights swapped into a bf16-converted predictor are cast to
+    match the exported program's avals."""
+    path = str(tmp_path / "model_bf16_swap")
+    x, _ = _build_and_save(path, convert="bfloat16")
+    predictor = jit.load(path)
+    paddle.seed(99)
+    net2 = SmallNet()
+    predictor.set_state_dict(net2.state_dict())  # fp32 weights
+    out = predictor(paddle.to_tensor(x))  # must not dtype-mismatch
+    assert np.all(np.isfinite(np.asarray(out._array)))
+
+
 def test_predictor_weight_swap(tmp_path):
     """set_state_dict swaps weights without retracing (zero-copy-ish
     serving update)."""

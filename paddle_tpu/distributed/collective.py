@@ -178,15 +178,18 @@ def _replicated(mesh):
     return NamedSharding(mesh, P())
 
 
-_PROGRAMS = {
-    "identity": lambda g: g,
-    "swap01": lambda g: jnp.swapaxes(g, 0, 1),
-    **{f"reduce_{name}": functools.partial(
-        lambda red, g: red(g, axis=0), red)
-       for name, red in _REDUCERS.items()},
-    **{f"select_{i}": functools.partial(lambda i, g: g[i], i)
-       for i in range(64)},
-}
+def _program_for(kind: str):
+    if kind == "identity":
+        return lambda g: g
+    if kind == "swap01":
+        return lambda g: jnp.swapaxes(g, 0, 1)
+    if kind.startswith("reduce_"):
+        red = _REDUCERS[kind[len("reduce_"):]]
+        return functools.partial(lambda red, g: red(g, axis=0), red)
+    if kind.startswith("select_"):
+        i = int(kind[len("select_"):])
+        return functools.partial(lambda i, g: g[i], i)
+    raise KeyError(kind)
 
 
 @functools.lru_cache(maxsize=None)
@@ -195,7 +198,7 @@ def _jitted_program(kind: str, ranks: tuple):
     on function identity, so per-call lambdas would retrace+recompile on
     every invocation (hundreds of ms each on TPU)."""
     mesh = _group_mesh(ranks)
-    return jax.jit(_PROGRAMS[kind], out_shardings=_replicated(mesh))
+    return jax.jit(_program_for(kind), out_shardings=_replicated(mesh))
 
 
 def _run_collective(arr, ranks, kind):
@@ -211,6 +214,15 @@ def _run_collective(arr, ranks, kind):
 def _ret(tensor: Tensor, value) -> Tensor:
     tensor.set_value(jnp.asarray(value, tensor._array.dtype))
     return tensor
+
+
+def _stack_list(tensor_list, ranks, what):
+    if len(tensor_list) != len(ranks):
+        raise ValueError(
+            f"{what} needs exactly one tensor per group member "
+            f"({len(ranks)}), got {len(tensor_list)}")
+    return jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
+                      for t in tensor_list])
 
 
 # ---------------------------------------------------------------------------
@@ -279,8 +291,7 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
     si = ranks.index(src)
     my = ranks.index(me)
     if me == src:
-        stacked = jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
-                             for t in tensor_list])
+        stacked = _stack_list(tensor_list, ranks, "scatter tensor_list")
     else:
         stacked = jnp.zeros((len(ranks),) + tuple(tensor._array.shape),
                             tensor._array.dtype)
@@ -296,8 +307,7 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
     me = ranks.index(jax.process_index())
-    stacked = jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
-                         for t in in_tensor_list])
+    stacked = _stack_list(in_tensor_list, ranks, "alltoall in_tensor_list")
     # global [P, P, *s]: row i = process i's send list; my receives = column me
     out = _run_collective(stacked, ranks, "swap01")
     for j in range(len(ranks)):
@@ -313,8 +323,7 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
         tensor.set_value(tensor_list[0])
         return tensor
     me = ranks.index(jax.process_index())
-    stacked = jnp.stack([t._array if isinstance(t, Tensor) else jnp.asarray(t)
-                         for t in tensor_list])
+    stacked = _stack_list(tensor_list, ranks, "reduce_scatter tensor_list")
     out = _run_collective(stacked, ranks, f"reduce_{op}")
     return _ret(tensor, out[me])
 

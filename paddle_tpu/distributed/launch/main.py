@@ -33,12 +33,6 @@ import threading
 import time
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def build_parser():
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.distributed.launch",
@@ -89,34 +83,55 @@ def _stream(proc, rank):
 
 def launch(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    master = args.master or f"127.0.0.1:{_free_port()}"
+    if args.master:
+        return _launch_once(args, args.master, None)
+    # hold the probe socket (SO_REUSEADDR) until the ranks are spawned so
+    # another process can't grab the auto-picked coordinator port in the
+    # selection->bind window; rank 0's coordination service binds with
+    # reuse and takes over
+    probe = socket.socket()
+    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    return _launch_once(args, f"127.0.0.1:{port}", probe)
 
+
+def _launch_once(args, master: str, probe) -> int:
     procs = []
     streams = []
     logs = []
-    for rank in range(args.nprocs):
-        env = _rank_env(args, rank, master)
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            logf = open(os.path.join(args.log_dir, f"rank{rank}.log"), "w")
-            logs.append(logf)
-            proc = subprocess.Popen(
-                [sys.executable, args.script] + args.script_args,
-                env=env, stdout=logf, stderr=subprocess.STDOUT)
-        else:
-            proc = subprocess.Popen(
-                [sys.executable, args.script] + args.script_args,
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-            t = threading.Thread(target=_stream, args=(proc, rank))
-            t.daemon = True
-            t.start()
-            streams.append(t)
-        procs.append(proc)
-
-    # watch loop (ControllerBase.watch analog): first failure kills the pod
+    # spawn AND watch inside one try so a mid-spawn failure still tears
+    # down the ranks already started
     rc = 0
     try:
-        pending = set(range(args.nprocs))
+        for rank in range(args.nprocs):
+            env = _rank_env(args, rank, master)
+            if probe is not None:
+                # release the coordinator port at the last moment (rank
+                # 0's bind happens moments later; a same-port steal now
+                # needs to win a microsecond window instead of the whole
+                # env-setup span)
+                probe.close()
+                probe = None
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+                logf = open(os.path.join(args.log_dir, f"rank{rank}.log"), "w")
+                logs.append(logf)
+                proc = subprocess.Popen(
+                    [sys.executable, args.script] + args.script_args,
+                    env=env, stdout=logf, stderr=subprocess.STDOUT)
+            else:
+                proc = subprocess.Popen(
+                    [sys.executable, args.script] + args.script_args,
+                    env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+                t = threading.Thread(target=_stream, args=(proc, rank))
+                t.daemon = True
+                t.start()
+                streams.append(t)
+            procs.append(proc)
+
+        # watch loop (ControllerBase.watch analog): first failure kills the pod
+        pending = set(range(len(procs)))
         while pending:
             for i in list(pending):
                 r = procs[i].poll()
@@ -136,6 +151,11 @@ def launch(argv=None) -> int:
                     pending.clear()
                     break
             time.sleep(0.2)
+    except BaseException:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
     finally:
         for t in streams:
             t.join(timeout=5)

@@ -102,9 +102,12 @@ class MoELayer(nn.Layer):
     """Analog of incubate MoELayer (moe_layer.py:260).
 
     Experts are stored BATCHED: w1 [E, d, h], w2 [E, h, d] — one einsum
-    runs all local experts on the MXU; the 'ep' mesh axis shards the E
-    dim (dist_spec), so XLA partitions expert compute and inserts the
-    all-to-all for token exchange.
+    runs all local experts on the MXU. With ep degree 1 the whole layer
+    is a dense local computation; with ep > 1 the forward switches to an
+    explicit shard_map over the 'ep' mesh axis with lax.all_to_all token
+    dispatch and return (_forward_ep — the global_scatter/global_gather
+    analog), and the expert weights carry dist_spec P('ep') so the
+    surrounding pjit keeps them sharded at rest.
     """
 
     def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
@@ -128,25 +131,34 @@ class MoELayer(nn.Layer):
         self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
         ep = get_hybrid_communicate_group().axis_size("ep")
         if ep > 1:
-            assert num_experts % ep == 0
+            if num_experts % ep:
+                raise ValueError(
+                    f"ep={ep} must divide num_experts={num_experts}")
             for p in (self.w1, self.b1, self.w2, self.b2):
                 p.dist_spec = P("ep")
         self.aux_loss = None
 
+    def _gating(self, gt, cap):
+        if self.gate_type == "switch":
+            return switch_gating(gt, cap)
+        return top2_gating(gt, cap)
+
     def forward(self, x):
         B, S, D = x.shape
         E = self.num_experts
-        cap = int(self.capacity_factor * B * S / E) or 1
+        ep = get_hybrid_communicate_group().axis_size("ep")
         gate_t = self.gate_proj(x)  # [B,S,E] tracked op
+
+        if ep > 1:
+            return self._forward_ep(x, gate_t, ep)
+
+        cap = int(self.capacity_factor * B * S / E) or 1
 
         def fn(xa, ga, w1, b1, w2, b2):
             T = B * S
             xt = xa.reshape(T, D)
             gt = ga.reshape(T, E)
-            if self.gate_type == "switch":
-                combine, dispatch, aux = switch_gating(gt, cap)
-            else:
-                combine, dispatch, aux = top2_gating(gt, cap)
+            combine, dispatch, aux = self._gating(gt, cap)
             # dispatch: [T,E,C] one-hot -> expert buffers [E,C,D]
             buf = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)
             h = jnp.einsum("ecd,edh->ech", buf, w1) + b1
@@ -157,6 +169,73 @@ class MoELayer(nn.Layer):
             return y.reshape(B, S, D), aux
 
         out, aux = apply("moe", fn, x, gate_t, self.w1, self.b1, self.w2,
+                         self.b2)
+        self.aux_loss = aux
+        return out
+
+    def _forward_ep(self, x, gate_t, ep):
+        """Expert-parallel forward: shard_map over 'ep' with explicit
+        lax.all_to_all token exchange — the global_scatter/global_gather
+        analog (operators/collective/global_scatter_op.cu.cc,
+        moe_utils.py). Tokens are sharded over 'ep'; each shard gates its
+        local tokens, ships per-expert buffers to the expert owners,
+        runs its local experts, and ships results back."""
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+
+        B, S, D = x.shape
+        E = self.num_experts
+        if E % ep:
+            raise ValueError(
+                f"ep={ep} must divide num_experts={E}")
+        E_loc = E // ep
+        T = B * S
+        if T % ep:
+            raise ValueError(
+                f"ep={ep} must divide token count {T}")
+        T_loc = T // ep
+        cap = int(self.capacity_factor * T_loc / E) or 1
+        mesh = get_hybrid_communicate_group().mesh
+
+        def shard_fn(xt, gt, w1, b1, w2, b2):
+            # per-shard: xt [T_loc, D], gt [T_loc, E], w1 [E_loc, D, H]...
+            combine, dispatch, aux = self._gating(gt[0], cap)
+            buf = jnp.einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt[0])
+            # [E, cap, D] -> [ep, E_loc, cap, D]; all_to_all sends slice j
+            # to ep-rank j (every expert's tokens to its owner)
+            buf = buf.reshape(ep, E_loc, cap, D)
+            recv = jax.lax.all_to_all(buf, "ep", split_axis=0, concat_axis=0,
+                                      tiled=False)
+            # recv[j] = rank j's tokens for MY experts -> [E_loc, ep*cap, D]
+            recv = jnp.swapaxes(recv, 0, 1).reshape(E_loc, ep * cap, D)
+            h = jnp.einsum("ecd,edh->ech", recv, w1[0]) + b1[0]
+            h = jax.nn.gelu(h)
+            out = jnp.einsum("ech,ehd->ecd", h, w2[0]) + b2[0]
+            # ship results back: [E_loc, ep, cap, D] -> [ep, E_loc, cap, D]
+            out = jnp.swapaxes(out.reshape(E_loc, ep, cap, D), 0, 1)
+            back = jax.lax.all_to_all(out, "ep", split_axis=0, concat_axis=0,
+                                      tiled=False)
+            # back = my tokens' outputs from every expert group -> [E,cap,D]
+            back = back.reshape(E, cap, D)
+            y = jnp.einsum("tec,ecd->td", combine, back)
+            aux = jax.lax.pmean(aux, "ep")
+            return y[None], aux[None]
+
+        smapped = shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("ep"), P("ep"), P("ep"), P("ep"), P("ep"), P("ep")),
+            out_specs=(P("ep"), P("ep")))
+
+        def fn(xa, ga, w1, b1, w2, b2):
+            xt = xa.reshape(ep, T_loc, D)
+            gt = ga.reshape(ep, T_loc, E)
+            y, aux = smapped(xt, gt, w1.reshape(ep, E_loc, D, -1),
+                             b1.reshape(ep, E_loc, 1, -1),
+                             w2.reshape(ep, E_loc, -1, D),
+                             b2.reshape(ep, E_loc, 1, D))
+            return y.reshape(B, S, D), jnp.mean(aux)
+
+        out, aux = apply("moe_ep", fn, x, gate_t, self.w1, self.b1, self.w2,
                          self.b2)
         self.aux_loss = aux
         return out

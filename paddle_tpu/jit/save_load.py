@@ -111,17 +111,33 @@ def save(layer, path, input_spec=None, convert=None, **configs):
 
         # None/-1 dims become symbolic (jax.export shape polymorphism):
         # the loaded predictor then accepts any size there (the dynamic-
-        # batch contract of paddle.static.InputSpec)
-        sym_count = 0
+        # batch contract of paddle.static.InputSpec). A string dim names
+        # its symbol, so specs can SHARE a dimension (e.g. two inputs
+        # with the same "batch") — unnamed dims are independent symbols.
+        # All symbols must live in ONE scope, so they are created in a
+        # single symbolic_shape call and distributed by name.
+        user_names = {d for s in input_spec for d in s.shape
+                      if isinstance(d, str)}
+        auto_names = iter(n for i in range(10000)
+                          if (n := f"_b{i}") not in user_names)
+        # per-dim resolved name (None = static), computed once so both
+        # the symbol-scope pass and the shape pass agree
+        dim_names = [[d if isinstance(d, str)
+                      else next(auto_names)
+                      if d is None or (isinstance(d, int) and d < 0)
+                      else None
+                      for d in s.shape] for s in input_spec]
+        names = []
+        for row in dim_names:
+            for n in row:
+                if n is not None and n not in names:
+                    names.append(n)
+        syms = dict(zip(names, jax_export.symbolic_shape(",".join(names)))) \
+            if names else {}
         example = []
-        for s in input_spec:
-            dims = []
-            for d in s.shape:
-                if d is None or (isinstance(d, int) and d < 0):
-                    dims.append(jax_export.symbolic_shape(f"_b{sym_count}")[0])
-                    sym_count += 1
-                else:
-                    dims.append(d)
+        for s, row in zip(input_spec, dim_names):
+            dims = [d if n is None else syms[n]
+                    for d, n in zip(s.shape, row)]
             dt = s.dtype if isinstance(s.dtype, str) else "float32"
             example.append(jax.ShapeDtypeStruct(tuple(dims), jnp.dtype(dt)))
 

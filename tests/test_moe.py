@@ -1,0 +1,144 @@
+"""MoE expert-parallel tests (VERDICT r2 #5): the ep>1 path must run a
+REAL lax.all_to_all token exchange inside shard_map, and ep=2 training
+must match ep=1 when capacity doesn't bind.
+
+Reference analogs: incubate/distributed/models/moe/moe_layer.py:260,
+operators/collective/global_scatter_op.cu.cc.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                    set_hybrid_communicate_group)
+from paddle_tpu.distributed.moe import MoELayer
+
+
+E = 4  # experts; capacity_factor=E -> capacity == tokens, nothing drops
+
+
+def _mk_layer(ep_degree, seed=0):
+    set_hybrid_communicate_group(HybridCommunicateGroup(ep=ep_degree))
+    paddle.seed(seed)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=E,
+                     capacity_factor=float(E))
+    return layer
+
+
+def _state(layer):
+    return {k: np.asarray(v._array) for k, v in layer.state_dict().items()}
+
+
+def test_ep2_forward_parity():
+    """Same weights, same input: ep=2 output == ep=1 output (no token
+    drops at capacity_factor=E)."""
+    x_np = np.random.RandomState(0).uniform(-1, 1, (2, 8, 16)).astype(np.float32)
+
+    l1 = _mk_layer(1, seed=3)
+    w = _state(l1)
+    y1 = l1(paddle.to_tensor(x_np))
+    aux1 = float(l1.aux_loss._array if hasattr(l1.aux_loss, "_array")
+                 else l1.aux_loss)
+
+    l2 = _mk_layer(2, seed=3)
+    l2.set_state_dict(w)
+    y2 = l2(paddle.to_tensor(x_np))
+    aux2 = float(l2.aux_loss._array if hasattr(l2.aux_loss, "_array")
+                 else l2.aux_loss)
+
+    set_hybrid_communicate_group(HybridCommunicateGroup())  # reset
+    np.testing.assert_allclose(np.asarray(y1._array), np.asarray(y2._array),
+                               rtol=1e-4, atol=1e-5)
+    # ep gating runs per shard: aux is the mean of per-shard losses, not
+    # identical to the global one — but should be close for uniform data
+    assert abs(aux1 - aux2) < 0.5
+
+
+def test_ep2_contains_all_to_all():
+    """The claim under test: ep>1 dispatch really compiles to all-to-all
+    collectives (not annotation-only)."""
+    import jax
+
+    l2 = _mk_layer(2, seed=1)
+    x = paddle.to_tensor(
+        np.random.uniform(-1, 1, (2, 8, 16)).astype(np.float32))
+
+    def f(xa, w1, b1, w2, b2, gw):
+        l2.gate_proj.weight._array = gw
+        l2.w1._array, l2.b1._array = w1, b1
+        l2.w2._array, l2.b2._array = w2, b2
+        from paddle_tpu.core.tensor import Tensor
+
+        return l2(Tensor._wrap(xa))._array
+
+    hlo = jax.jit(f).lower(
+        x._array, l2.w1._array, l2.b1._array, l2.w2._array, l2.b2._array,
+        l2.gate_proj.weight._array).as_text()
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+    assert "all_to_all" in hlo or "all-to-all" in hlo, \
+        "ep>1 MoE must lower to all_to_all"
+
+
+class TinyMoENet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.inp = nn.Linear(8, 16)
+        self.moe = MoELayer(d_model=16, d_hidden=32, num_experts=E,
+                            capacity_factor=float(E))
+        self.out = nn.Linear(16, 4)
+
+    def forward(self, x):
+        h = F.relu(self.inp(x))
+        h = self.moe(h.reshape([h.shape[0], 1, 16]))
+        return self.out(h.reshape([h.shape[0], 16]))
+
+
+def test_ep2_training_parity():
+    """ep=2 DistributedTrainStep loss trace == ep=1 TrainStep loss trace
+    (the hybrid_parallel parity-test pattern, test_dist_base.py style)."""
+    import paddle_tpu.jit as jit
+    from paddle_tpu.distributed import DistributedTrainStep
+
+    rng = np.random.RandomState(7)
+    xs = rng.uniform(-1, 1, (4, 8, 8)).astype(np.float32)
+    ys = rng.randint(0, 4, (4, 8)).astype(np.int64)
+
+    def loss_fn(logits, label):
+        return F.cross_entropy(logits, label)
+
+    def run(ep_degree):
+        hcg = HybridCommunicateGroup(ep=ep_degree)
+        set_hybrid_communicate_group(hcg)
+        paddle.seed(0)
+        net = TinyMoENet()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                     parameters=net.parameters())
+        if ep_degree > 1:
+            step = DistributedTrainStep(net, opt, loss_fn, hcg=hcg,
+                                        batch_axes=("dp",))
+        else:
+            step = jit.TrainStep(net, opt, loss_fn)
+        losses = []
+        for i in range(4):
+            losses.append(float(step(paddle.to_tensor(xs[i]),
+                                     paddle.to_tensor(ys[i]))))
+        return losses
+
+    base = run(1)
+    ep2 = run(2)
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+    np.testing.assert_allclose(base, ep2, rtol=2e-4, atol=1e-5)
+
+
+def test_switch_gate_ep2():
+    x_np = np.random.RandomState(1).uniform(-1, 1, (2, 8, 16)).astype(np.float32)
+    set_hybrid_communicate_group(HybridCommunicateGroup(ep=2))
+    paddle.seed(5)
+    layer = MoELayer(d_model=16, d_hidden=32, num_experts=E, gate="switch",
+                     capacity_factor=float(E))
+    y = layer(paddle.to_tensor(x_np))
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+    assert y.shape == [2, 8, 16]
+    assert np.all(np.isfinite(np.asarray(y._array)))

@@ -25,7 +25,8 @@ from .mp_layers import (
 from .moe import MoELayer
 from .recompute import recompute
 from .ring_attention import ring_attention, ulysses_attention
-from .sharding import group_sharded_parallel, save_group_sharded_model
+from .sharding import (group_sharded_parallel, make_sharded_step,
+                       save_group_sharded_model)
 from .spmd import DistributedTrainStep
 from .collective import (
     Group,

@@ -31,11 +31,17 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
     reference naming.
     """
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
-    if offload:
-        raise NotImplementedError(
-            "CPU offload: planned via jax host-memory sharding (round 2)")
+    # consumed by DistributedTrainStep.__init__ (stage/offload default
+    # from these attrs), so reference-style callers get the real thing
     model._sharding_stage = stage
+    model._sharding_offload = bool(offload)
     model._sharding_scaler = scaler
+    if offload:
+        import warnings
+        warnings.warn(
+            "offload takes effect in compiled steps (DistributedTrainStep /"
+            " make_sharded_step); a plain eager loss.backward()/opt.step()"
+            " loop keeps optimizer state on device", stacklevel=2)
     return model, optimizer, scaler
 
 
@@ -61,8 +67,12 @@ def save_group_sharded_model(model, output, optimizer=None):
         paddle_tpu.save(optimizer.state_dict(), output + ".pdopt")
 
 
-def make_sharded_step(model, optimizer, loss_fn=None, level="os_g"):
-    """Convenience: the compiled ZeRO step for this model/opt pair."""
-    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+def make_sharded_step(model, optimizer, loss_fn=None, level=None,
+                      offload=None):
+    """Convenience: the compiled ZeRO step for this model/opt pair.
+    level/offload default to whatever group_sharded_parallel recorded on
+    the model (explicit arguments win)."""
+    stage = None if level is None else {"os": 1, "os_g": 2,
+                                        "p_g_os": 3}[level]
     return DistributedTrainStep(model, optimizer, loss_fn,
-                                sharding_stage=stage)
+                                sharding_stage=stage, offload=offload)

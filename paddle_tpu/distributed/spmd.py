@@ -76,13 +76,21 @@ class DistributedTrainStep:
 
     def __init__(self, model, optimizer, loss_fn=None,
                  hcg: Optional[HybridCommunicateGroup] = None,
-                 sharding_stage: int = 0, batch_axes=("dp", "sharding"),
-                 donate: bool = True):
+                 sharding_stage: Optional[int] = None,
+                 batch_axes=("dp", "sharding"),
+                 donate: bool = True, offload: Optional[bool] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.hcg = hcg or get_hybrid_communicate_group()
+        # group_sharded_parallel() records its stage/offload on the model;
+        # an explicit argument wins, so both entry styles work
+        if sharding_stage is None:
+            sharding_stage = getattr(model, "_sharding_stage", 0)
         self.sharding_stage = sharding_stage
+        if offload is None:
+            offload = getattr(model, "_sharding_offload", False)
+        self.offload = bool(offload)
         self.batch_axes = tuple(a for a in batch_axes
                                 if self.hcg.axis_size(a) > 1) or None
         optimizer._ensure_state()
@@ -113,13 +121,35 @@ class DistributedTrainStep:
             p._array = jax.device_put(p._array, ns)
         opt = self.optimizer
         opt._ensure_state()
+        rest = self._acc_host_shardings() if self.offload \
+            else self._acc_dev_shardings()
         for k, lst in opt._accumulators.items():
             for out_pos, j in enumerate(self._acc_idx):
-                s = accum_pspec(specs[out_pos], self._params[out_pos],
-                                self.hcg, self.sharding_stage)
-                lst[j] = jax.device_put(lst[j],
-                                        NamedSharding(self.hcg.mesh, s))
+                lst[j] = jax.device_put(lst[j], rest[out_pos])
         self._placed = True
+
+    def _acc_dev_shardings(self):
+        """Per-param accumulator NamedShardings (device memory), cached —
+        the offload path rebuilds these on every step otherwise."""
+        if getattr(self, "_acc_dev_cache", None) is None:
+            specs, _ = self._param_shardings()
+            self._acc_dev_cache = [
+                NamedSharding(self.hcg.mesh,
+                              accum_pspec(specs[i], self._params[i],
+                                          self.hcg, self.sharding_stage))
+                for i in range(len(self._params))]
+        return self._acc_dev_cache
+
+    def _acc_host_shardings(self):
+        """Same specs, pinned_host memory kind: offload parks optimizer
+        state in host RAM between steps (group_sharded offload analog);
+        __call__ stages it to device around the compiled update."""
+        if getattr(self, "_acc_host_cache", None) is None:
+            self._acc_host_cache = [
+                NamedSharding(self.hcg.mesh, ns.spec,
+                              memory_kind="pinned_host")
+                for ns in self._acc_dev_shardings()]
+        return self._acc_host_cache
 
     def _build(self):
         model = self.model
@@ -133,12 +163,8 @@ class DistributedTrainStep:
         opt._ensure_state()
         accum_names = list(opt._accumulators.keys())
         pspecs, param_shardings = self._param_shardings()
-        acc_shardings = {
-            k: [NamedSharding(mesh, accum_pspec(pspecs[i], params[i], hcg,
-                                                self.sharding_stage))
-                for i in range(len(params))]
-            for k in accum_names
-        }
+        dev = self._acc_dev_shardings()
+        acc_shardings = {k: dev for k in accum_names}
         repl = NamedSharding(mesh, P())
 
         step_fn = build_step_fn(model, opt, loss_fn, params, self._acc_idx)
@@ -174,6 +200,13 @@ class DistributedTrainStep:
 
         param_arrays = [p._array for p in self._params]
         accums = gather_accums(opt, self._acc_idx)
+        if self.offload:
+            # stage host-resident opt state into device memory for the
+            # compiled update; the device copies are donated by the jit
+            dev = self._acc_dev_shardings()
+            accums = {k: [jax.device_put(a, dev[i])
+                          for i, a in enumerate(lst)]
+                      for k, lst in accums.items()}
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
         loss, new_params, new_accums = self._jitted(
@@ -181,6 +214,11 @@ class DistributedTrainStep:
             random_mod.next_key())
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
+        if self.offload:
+            host = self._acc_host_shardings()
+            new_accums = {
+                k: [jax.device_put(a, host[i]) for i, a in enumerate(lst)]
+                for k, lst in new_accums.items()}
         scatter_accums(opt, self._acc_idx, new_accums)
         opt._step_count += 1
         return Tensor._wrap(loss)

@@ -1,0 +1,95 @@
+"""ZeRO-3 end-to-end tests (VERDICT r2 #6): stage-3 params actually
+sharded over 'sharding' with XLA inserting the just-in-time all-gathers,
+training parity vs a plain eager loop, and host offload of optimizer
+state (group_sharded offload analog) via pinned_host memory kind.
+
+Reference analogs: distributed/sharding/group_sharded.py:37,
+meta_parallel/sharding/group_sharded_stage3.py:1117.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                    set_hybrid_communicate_group)
+
+
+def _make(seed):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 16))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    return net, opt
+
+
+def _data(n=5):
+    rs = np.random.RandomState(0)
+    return [(rs.randn(8, 16).astype(np.float32),
+             rs.randn(8, 16).astype(np.float32)) for _ in range(n)]
+
+
+def _eager_losses(data, seed):
+    net, opt = _make(seed)
+    losses = []
+    for x, y in data:
+        loss = F.mse_loss(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses, net
+
+
+def test_stage3_param_sharded_and_parity():
+    data = _data()
+    ref_losses, ref_net = _eager_losses(data, seed=11)
+
+    hcg = HybridCommunicateGroup(sharding=8)
+    set_hybrid_communicate_group(hcg)
+    net, opt = _make(seed=11)
+    net, opt, _ = dist.group_sharded_parallel(net, opt, level="p_g_os")
+    # no explicit sharding_stage: must come from group_sharded_parallel
+    step = dist.DistributedTrainStep(net, opt,
+                                     lambda o, t: F.mse_loss(o, t), hcg=hcg)
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for x, y in data]
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+
+    assert step.sharding_stage == 3
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-6)
+    # ZeRO-3: the weights themselves are sharded over 'sharding'
+    w = net[0].weight
+    assert "sharding" in str(w._array.sharding.spec)
+    m = opt._accumulators["moment1"][0]
+    assert "sharding" in str(m.sharding.spec)
+    # final weights match the eager baseline
+    for (k, a), (_, b) in zip(net.state_dict().items(),
+                              ref_net.state_dict().items()):
+        np.testing.assert_allclose(np.asarray(a._array),
+                                   np.asarray(b._array),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_stage3_offload_host_resident_opt_state():
+    data = _data()
+    ref_losses, _ = _eager_losses(data, seed=12)
+
+    hcg = HybridCommunicateGroup(sharding=8)
+    set_hybrid_communicate_group(hcg)
+    net, opt = _make(seed=12)
+    with pytest.warns(UserWarning, match="offload takes effect"):
+        net, opt, _ = dist.group_sharded_parallel(net, opt, level="p_g_os",
+                                                  offload=True)
+    # no level/offload here: must come from the model attrs
+    step = dist.make_sharded_step(net, opt, lambda o, t: F.mse_loss(o, t))
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for x, y in data]
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-6)
+    # optimizer state parked in host memory between steps
+    m = opt._accumulators["moment1"][0]
+    assert m.sharding.memory_kind == "pinned_host"

@@ -7,7 +7,8 @@ paddle-parity eager API is kept as a thin façade.
 """
 from jax.sharding import PartitionSpec
 
-from . import fleet, functional, moe, mp_layers, pipeline, ring_attention, sharding
+from . import (fleet, functional, moe, mp_layers, pipeline, ps,
+               ring_attention, sharding)
 from .pipeline import (
     LayerDesc,
     PipelineLayer,

@@ -1,0 +1,14 @@
+"""paddle.distributed.ps analog — PS-lite for TPU hosts.
+
+The reference runs dedicated brpc parameter-server processes
+(distributed/ps/service/brpc_ps_server.h) holding sharded sparse tables
+(table/memory_sparse_table.h) with pluggable accessors/SGD rules; the
+TPU-native design keeps the table/accessor/pull/push taxonomy
+(ps/README.md) but serves shards from the TPU hosts' own RAM and rides
+the eager alltoall for the id exchange (SURVEY §7 PS row).
+"""
+from .embedding import DistributedEmbedding
+from .table import MemorySparseTable, SparseAdagradRule, SparseSGDRule
+
+__all__ = ["MemorySparseTable", "SparseAdagradRule", "SparseSGDRule",
+           "DistributedEmbedding"]

@@ -1,0 +1,65 @@
+"""DistributedEmbedding: the trainer-side lookup over a PS table.
+
+Analog of the reference's distributed_lookup path: the PS program
+builder replaces `lookup_table` ops with `distributed_lookup` /
+`distributed_push_sparse` against the PS service
+(python/paddle/distributed/ps/utils/ps_program_builder.py,
+the_one_ps.py:1164 _init_worker). Here the pull materializes ONLY the
+touched rows on device (dense [U, dim], MXU-friendly), the lookup is a
+tracked gather so the tape delivers per-row gradients, and
+`push_gradients()` ships them back to the host table where the accessor
+rule updates — the wide&deep / DeepFM training loop shape.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import manipulation
+
+from .table import MemorySparseTable
+
+__all__ = ["DistributedEmbedding"]
+
+
+class DistributedEmbedding(nn.Layer):
+    """Embedding whose weight lives in a host-RAM MemorySparseTable
+    instead of a device parameter. Use exactly like nn.Embedding in the
+    forward; call `push_gradients()` after `loss.backward()` (the
+    distributed_push_sparse step). The table IS the optimizer for these
+    rows — they never appear in `parameters()`.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, table=None,
+                 rule=None, nshards=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings  # advisory; table is sparse
+        self._embedding_dim = embedding_dim
+        self.table = table or MemorySparseTable(
+            embedding_dim, rule=rule, nshards=nshards,
+            name=name or "embedding_table")
+        self._pending = []
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids._array if isinstance(ids, Tensor)
+                            else ids).astype(np.int64)
+        uniq, inv = np.unique(ids_np.ravel(), return_inverse=True)
+        pulled = Tensor(self.table.pull(uniq))
+        pulled.stop_gradient = False  # leaf: backward accumulates .grad
+        if self.training:
+            self._pending.append((uniq, pulled))
+        out = manipulation.gather(pulled, Tensor(inv.astype(np.int32)))
+        return out.reshape(list(ids_np.shape) + [self._embedding_dim])
+
+    def push_gradients(self):
+        """Push accumulated per-row grads into the table (one training
+        step's distributed_push_sparse)."""
+        for uniq, pulled in self._pending:
+            if pulled.grad is not None:
+                self.table.push(uniq, np.asarray(pulled.grad._array))
+        self._pending.clear()
+
+    def clear_gradients(self):
+        self._pending.clear()
+        super().clear_gradients()

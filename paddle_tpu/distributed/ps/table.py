@@ -1,0 +1,248 @@
+"""PS-lite: host-RAM sparse embedding tables with pull/push semantics.
+
+TPU-native analog of the reference parameter server
+(paddle/fluid/distributed/ps/table/memory_sparse_table.h, SGD rules
+paddle/fluid/distributed/ps/table/sparse_sgd_rule.h, python runtime
+python/paddle/distributed/ps/the_one_ps.py:1031). The reference shards
+a huge id->row hash map across brpc PS server processes; trainers pull
+touched rows, compute on GPU, and push sparse gradients back.
+
+Here the "servers" are the TPU hosts themselves: each process owns the
+rows whose `id % nshards` hash to it, stored in host RAM (numpy, lazily
+materialized like the reference's on-first-touch entries — vocab never
+needs to be materialized densely). A training step pulls only the
+touched rows to device HBM, runs the dense math on the MXU, and pushes
+per-row gradients back to the host table, where the accessor rule
+(SGD/Adagrad with per-row state) applies the update. Cross-process
+pulls/pushes ride the eager alltoall (collective.py) — the
+global_scatter-style id exchange — with count-padding so every process
+participates with equal shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SparseSGDRule", "SparseAdagradRule", "MemorySparseTable"]
+
+
+class SparseSGDRule:
+    """Per-row plain SGD (sparse_sgd_rule.h `SparseNaiveSGDRule`)."""
+
+    state_width = 0
+
+    def __init__(self, learning_rate=0.01):
+        self.lr = learning_rate
+
+    def init_state(self, dim):
+        return np.zeros((0,), np.float32)
+
+    def update(self, row, state, grad):
+        row -= self.lr * grad
+        return row, state
+
+
+class SparseAdagradRule:
+    """Per-row Adagrad with a scalar accumulator per element
+    (sparse_sgd_rule.h `SparseAdaGradSGDRule`)."""
+
+    def __init__(self, learning_rate=0.05, initial_g2sum=0.0, eps=1e-8):
+        self.lr = learning_rate
+        self.g0 = initial_g2sum
+        self.eps = eps
+
+    def init_state(self, dim):
+        return np.full((dim,), self.g0, np.float32)
+
+    def update(self, row, state, grad):
+        state += grad * grad
+        row -= self.lr * grad / (np.sqrt(state) + self.eps)
+        return row, state
+
+
+class _Shard:
+    """One hash shard: id -> (row, accessor state), lazily created."""
+
+    def __init__(self, dim, rule, initializer, seed):
+        self.dim = dim
+        self.rule = rule
+        self.rows: dict[int, np.ndarray] = {}
+        self.states: dict[int, np.ndarray] = {}
+        self._init = initializer
+        self._rng = np.random.RandomState(seed)
+
+    def _materialize(self, i):
+        if i not in self.rows:
+            self.rows[i] = self._init(self._rng, self.dim).astype(np.float32)
+            self.states[i] = self.rule.init_state(self.dim)
+        return self.rows[i]
+
+    def pull(self, ids):
+        return np.stack([self._materialize(int(i)) for i in ids]) \
+            if len(ids) else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids, grads):
+        for i, g in zip(ids, grads):
+            i = int(i)
+            self._materialize(i)
+            self.rows[i], self.states[i] = self.rule.update(
+                self.rows[i], self.states[i], g)
+
+
+def _default_init(rng, dim):
+    bound = 1.0 / np.sqrt(dim)
+    return rng.uniform(-bound, bound, size=(dim,))
+
+
+class MemorySparseTable:
+    """Sharded host-RAM sparse table with pull/push.
+
+    Single process: `nshards` local hash shards (parallelism-ready
+    layout; pulls concatenate across shards). Multi-process (after
+    init_parallel_env): shard p lives on process p — pulls/pushes for
+    remote ids ride the eager alltoall, so every host serves its share
+    of the vocabulary from its own RAM (the brpc PS server analog).
+    """
+
+    def __init__(self, dim, rule=None, nshards=None, initializer=None,
+                 seed=0, name="sparse_table"):
+        import jax
+
+        self.dim = dim
+        self.rule = rule or SparseAdagradRule()
+        self.name = name
+        self._nproc = jax.process_count()
+        self._rank = jax.process_index()
+        if self._nproc > 1:
+            nshards = self._nproc
+        self.nshards = nshards or 1
+        init = initializer or _default_init
+        if self._nproc > 1:
+            # one local shard: the slice of the hash space this host owns
+            self._shards = {self._rank: _Shard(dim, self.rule, init,
+                                               seed + self._rank)}
+        else:
+            self._shards = {s: _Shard(dim, self.rule, init, seed + s)
+                            for s in range(self.nshards)}
+
+    # -- local (single-process) path ------------------------------------
+    def _owner(self, ids):
+        return np.asarray(ids) % self.nshards
+
+    def pull(self, ids):
+        """ids [N] int -> rows [N, dim] float32 (host numpy)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        if self._nproc > 1:
+            return self._pull_remote(ids)
+        owners = self._owner(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        for s, shard in self._shards.items():
+            m = owners == s
+            if m.any():
+                out[m] = shard.pull(ids[m])
+        return out
+
+    def push(self, ids, grads):
+        """Apply per-row gradients (accessor update) to the table."""
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        if self._nproc > 1:
+            self._push_remote(ids, grads)
+            return
+        owners = self._owner(ids)
+        for s, shard in self._shards.items():
+            m = owners == s
+            if m.any():
+                shard.push(ids[m], grads[m])
+
+    # -- cross-process path (global_scatter/global_gather analog) --------
+    # 64-bit ids travel as two int32 words (jax runs x64-disabled, so an
+    # int64 or float32 round trip would silently truncate ids >= 2^31 /
+    # 2^24); a hi-word of -1 marks padding, so no count exchange needed.
+
+    def _exchange_ids(self, ids, owners):
+        """One max-size all_reduce + one alltoall: every owner gets the
+        ids requested of it (ragged, recovered via the hi>=0 mask)."""
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        counts = [int((owners == p).sum()) for p in range(self._nproc)]
+        maxc = paddle.to_tensor(np.array([max(counts)], np.float32))
+        dist.all_reduce(maxc, op=dist.ReduceOp.MAX)
+        M = max(int(np.asarray(maxc._array)[0]), 1)
+        ins = []
+        for p in range(self._nproc):
+            pad = np.full((M, 2), -1, np.int32)
+            sel = ids[owners == p]
+            pad[:len(sel), 0] = (sel & 0xFFFFFFFF).astype(np.uint32) \
+                                                  .view(np.int32)
+            pad[:len(sel), 1] = (sel >> 32).astype(np.int32)
+            ins.append(paddle.to_tensor(pad))
+        outs = []
+        dist.alltoall(ins, outs)
+        got = []
+        for o in outs:
+            w = np.asarray(o._array)
+            w = w[w[:, 1] >= 0]
+            got.append((w[:, 1].astype(np.int64) << 32)
+                       | (w[:, 0].view(np.uint32).astype(np.int64)))
+        return got, M, counts
+
+    def _exchange_rows(self, per_peer_rows, M):
+        """One float32 alltoall of [M, dim] blocks; the caller knows the
+        true per-peer counts, so padding needs no signalling."""
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        ins = []
+        for a in per_peer_rows:
+            pad = np.zeros((M, a.shape[1]), np.float32)
+            pad[:len(a)] = a
+            ins.append(paddle.to_tensor(pad))
+        outs = []
+        dist.alltoall(ins, outs)
+        return [np.asarray(o._array) for o in outs]
+
+    def _pull_remote(self, ids):
+        owners = np.asarray(ids) % self._nproc
+        got_ids, M, sent_counts = self._exchange_ids(ids, owners)
+        shard = self._shards[self._rank]
+        served = [shard.pull(g) for g in got_ids]
+        rows_back = self._exchange_rows(served, M)
+        out = np.empty((len(ids), self.dim), np.float32)
+        for p in range(self._nproc):
+            out[owners == p] = rows_back[p][:sent_counts[p]]
+        return out
+
+    def _push_remote(self, ids, grads):
+        owners = np.asarray(ids) % self._nproc
+        got_ids, M, _ = self._exchange_ids(ids, owners)
+        blocks = [grads[owners == p] for p in range(self._nproc)]
+        got_grads = self._exchange_rows(blocks, M)
+        shard = self._shards[self._rank]
+        for gi, gg in zip(got_ids, got_grads):
+            if len(gi):
+                shard.push(gi, gg[:len(gi)])
+
+    # -- introspection / checkpoint --------------------------------------
+    @property
+    def touched(self):
+        """Materialized row count (local shards)."""
+        return sum(len(s.rows) for s in self._shards.values())
+
+    def state_dict(self):
+        """Point-in-time copy (rules update rows in place). Keys are the
+        ids themselves: shard placement is derivable, so a checkpoint
+        reloads under any nshards/process count."""
+        return {str(i): (shard.rows[i].copy(), shard.states[i].copy())
+                for shard in self._shards.values()
+                for i in shard.rows}
+
+    def set_state_dict(self, state):
+        for key, (row, st) in state.items():
+            i = int(key)
+            s = i % self.nshards
+            if s not in self._shards:
+                continue  # another process owns this id
+            shard = self._shards[s]
+            shard.rows[i] = np.array(row, np.float32)
+            shard.states[i] = np.array(st, np.float32)

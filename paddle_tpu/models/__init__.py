@@ -1,7 +1,8 @@
 from .bert import (BertConfig, BertForPretraining,
                    BertForSequenceClassification, BertModel, ErnieModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+from .wide_deep import WideDeep
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "BertConfig",
            "BertModel", "ErnieModel", "BertForSequenceClassification",
-           "BertForPretraining"]
+           "BertForPretraining", "WideDeep"]

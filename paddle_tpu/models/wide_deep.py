@@ -1,0 +1,52 @@
+"""Wide&Deep CTR model over PS-lite sparse tables.
+
+The BASELINE wide&deep/DeepFM row trains sparse-feature CTR models
+through the reference parameter server (models in PaddleRec, runtime
+the_one_ps.py). This is the equivalent functional config: sparse id
+features -> DistributedEmbedding (host-RAM table, pull/push), a wide
+linear part over the same ids (dim-1 table) and a deep MLP over the
+concatenated embeddings, sigmoid CTR head.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.ps import DistributedEmbedding, SparseAdagradRule
+
+__all__ = ["WideDeep"]
+
+
+class WideDeep(nn.Layer):
+    """ids [B, num_fields] int64 -> click probability [B, 1].
+
+    Dense (MLP) params train with a normal device optimizer; sparse
+    rows train through the tables' accessor rules via push_sparse().
+    """
+
+    def __init__(self, num_fields, embedding_dim=8, hidden=(64, 32),
+                 sparse_lr=0.05, nshards=None):
+        super().__init__()
+        self.embedding = DistributedEmbedding(
+            0, embedding_dim, rule=SparseAdagradRule(sparse_lr),
+            nshards=nshards, name="deep_table")
+        self.wide = DistributedEmbedding(
+            0, 1, rule=SparseAdagradRule(sparse_lr), nshards=nshards,
+            name="wide_table")
+        layers, d = [], num_fields * embedding_dim
+        for h in hidden:
+            layers += [nn.Linear(d, h), nn.ReLU()]
+            d = h
+        layers.append(nn.Linear(d, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, ids):
+        B, nf = ids.shape
+        emb = self.embedding(ids)                    # [B, nf, D]
+        deep = self.deep(emb.reshape([B, -1]))       # [B, 1]
+        wide = self.wide(ids).sum(axis=1)            # [B, 1]
+        return F.sigmoid(deep + wide)
+
+    def push_sparse(self):
+        """After loss.backward(): apply sparse-row updates."""
+        self.embedding.push_gradients()
+        self.wide.push_gradients()

@@ -101,6 +101,29 @@ def run_train(dist, paddle, rank, world, out_file):
     print("ok train", losses, flush=True)
 
 
+def run_ps(dist, paddle, rank, world):
+    """2-process PS: each host owns id%2 rows; pulls/pushes for remote
+    ids ride the alltoall (the distributed_lookup/push_sparse path)."""
+    from paddle_tpu.distributed.ps import MemorySparseTable, SparseSGDRule
+
+    t = MemorySparseTable(dim=4, rule=SparseSGDRule(0.1))
+    assert t.nshards == world
+    # mixed-ownership ids incl. >2^24 (float32 would corrupt them)
+    ids = np.array([0, 1, 2, 3, 2**33 + 1])
+    rows = t.pull(ids)
+    assert rows.shape == (5, 4)
+    # remote and local rows agree across processes (same shard serves all)
+    again = t.pull(ids)
+    check("ps_pull_stable", again, rows)
+    # push from every process: owner applies BOTH pushes (sum over
+    # trainers, like the PS server accumulating pushed grads)
+    t.push(ids, np.ones((5, 4), np.float32))
+    dist.barrier()
+    after = t.pull(ids)
+    check("ps_push", after, rows - 0.1 * world)
+    print("ok ps", flush=True)
+
+
 def main():
     phase = sys.argv[1] if len(sys.argv) > 1 else "all"
     out_file = sys.argv[2] if len(sys.argv) > 2 else None
@@ -119,6 +142,8 @@ def main():
         run_collectives(dist, paddle, rank, world)
     if phase in ("all", "train"):
         run_train(dist, paddle, rank, world, out_file)
+    if phase in ("all", "ps"):
+        run_ps(dist, paddle, rank, world)
     print("WORKER_DONE", flush=True)
 
 

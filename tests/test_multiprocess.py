@@ -40,6 +40,12 @@ def test_two_process_collectives():
             f"missing 'ok {name}' in:\n{res.stdout}"
 
 
+def test_two_process_ps_pull_push():
+    res = _launch("ps")
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert res.stdout.count("ok ps\n") == 2
+
+
 def test_two_process_train_parity(tmp_path):
     out_file = str(tmp_path / "losses.json")
     res = _launch("train", out_file)

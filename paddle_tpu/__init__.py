@@ -87,6 +87,9 @@ from paddle_tpu import device  # noqa: E402
 from paddle_tpu import vision  # noqa: E402
 from paddle_tpu import metric  # noqa: E402
 from paddle_tpu import profiler  # noqa: E402
+from paddle_tpu import hapi  # noqa: E402
+from paddle_tpu.hapi import Model  # noqa: E402
+from paddle_tpu.hapi import callbacks  # noqa: E402
 
 # paddle-style helpers
 def is_grad_enabled():

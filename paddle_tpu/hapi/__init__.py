@@ -1,0 +1,6 @@
+"""hapi — the high-level API tier (python/paddle/hapi/): Model with
+fit/evaluate/predict/save/load plus the callback set."""
+from . import callbacks
+from .model import Model
+
+__all__ = ["Model", "callbacks"]

@@ -1,0 +1,184 @@
+"""hapi callbacks — analog of python/paddle/hapi/callbacks.py
+(ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler).
+
+The callback protocol matches the reference: config_callbacks builds a
+CallbackList; hooks fire around train/eval loops, epochs and batches,
+with `logs` dicts carrying loss/metrics/step counters.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    # train hooks
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    # eval hooks
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def call(self, name, *args, **kwargs):
+        for c in self.callbacks:
+            getattr(c, name)(*args, **kwargs)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch console logging (hapi ProgBarLogger, verbosity-gated)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def _fmt(self, logs):
+        return " - ".join(f"{k}: {v:.4f}" if isinstance(v, float)
+                          else f"{k}: {v}" for k, v in (logs or {}).items()
+                          if k not in ("batch_size",))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        if self.verbose:
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')}")
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and self.log_freq and \
+                (step + 1) % self.log_freq == 0:
+            print(f"step {step + 1}/{self.params.get('steps', '?')}"
+                  f" - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            print(f"epoch {epoch + 1} done in {dt:.1f}s - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+
+
+class ModelCheckpoint(Callback):
+    """Saves `{save_dir}/{epoch}` + `{save_dir}/final` (hapi parity)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when `monitor` stops improving (hapi EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0,
+                 min_delta=0, baseline=None, save_best_model=False):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.stopped_epoch = None
+        self.reset()
+
+    def reset(self):
+        self.wait = 0
+        self.best = self.baseline if self.baseline is not None else (
+            -float("inf") if self.mode == "max" else float("inf"))
+
+    def _better(self, v):
+        return v > self.best + self.min_delta if self.mode == "max" \
+            else v < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        v = (logs or {}).get(self.monitor)
+        if v is None:
+            return
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        if self._better(float(v)):
+            self.best = float(v)
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+                self.stopped_epoch = True
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (hapi LRScheduler callback:
+    by_step fires per train batch, else per epoch)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch, "choose exactly one cadence"
+        self.by_step = by_step
+
+    def _sched(self):
+        from paddle_tpu.optimizer.lr import LRScheduler as Sched
+
+        lr = getattr(self.model._optimizer, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+def config_callbacks(callbacks, model, epochs=None, steps=None, verbose=2,
+                     log_freq=1, save_dir=None, save_freq=1, metrics=None):
+    cbs = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbs):
+        cbs.insert(0, ProgBarLogger(log_freq, verbose=verbose))
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbs):
+        cbs.append(ModelCheckpoint(save_freq, save_dir))
+    if not any(isinstance(c, LRScheduler) for c in cbs):
+        cbs.append(LRScheduler())
+    params = {"epochs": epochs, "steps": steps, "verbose": verbose,
+              "metrics": metrics or []}
+    return CallbackList(cbs, model, params)

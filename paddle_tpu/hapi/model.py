@@ -1,0 +1,284 @@
+"""hapi Model — the high-level train/eval/predict loop, analog of
+python/paddle/hapi/model.py:1039 (Model.fit :1039, evaluate, predict,
+save/load, prepare).
+
+TPU-native: train steps run through jit.TrainStep (one fused XLA
+program per step, params/opt-state donated); train-time metrics ride
+value_and_grad's aux instead of a second forward; eval/predict are one
+jitted pure forward with params+buffers bound as traced args (no
+retrace across batches of the same shape).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _np(x):
+    return np.asarray(x._array if isinstance(x, Tensor) else x)
+
+
+def _to_loader(data, batch_size, shuffle, num_workers=0, drop_last=False):
+    from paddle_tpu.io import DataLoader, Dataset, IterableDataset
+
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, (Dataset, IterableDataset)):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+    return data  # any iterable of batches
+
+
+def _split_batch(batch):
+    """DataLoader batch -> (inputs tuple, label). hapi convention:
+    last element is the label."""
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        *ins, label = batch
+        return tuple(ins), label
+    return (batch,), None
+
+
+class Model:
+    """Usage (hapi parity):
+        model = paddle.Model(net)
+        model.prepare(optimizer, loss, metrics=[paddle.metric.Accuracy()])
+        model.fit(train_ds, eval_ds, epochs=2, batch_size=64)
+        model.evaluate(eval_ds); model.predict(test_ds)
+        model.save('ckpt/final')  # or save(path, training=False) -> jit.save
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_jit = None
+        self.stop_training = False
+
+    # -- setup ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else [metrics]
+        self._train_step = None
+        self._eval_jit = None
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # -- single-batch ops (train_batch/eval_batch/predict_batch parity) ---
+    def train_batch(self, inputs, labels=None):
+        from paddle_tpu.jit.api import TrainStep
+
+        if self._train_step is None:
+            self.network.train()
+            self._train_step = TrainStep(
+                self.network, self._optimizer, self._loss,
+                with_outputs=bool(self._metrics))
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(x) for x in ins]
+        label = labels if isinstance(labels, Tensor) or labels is None \
+            else Tensor(labels)
+        if self._metrics:
+            loss, out = self._train_step(*ins, label=label)
+            self._update_metrics(out, label)
+        else:
+            loss = self._train_step(*ins, label=label)
+        return float(loss._array)
+
+    def _build_eval(self):
+        import jax
+
+        network = self.network
+        loss_fn = self._loss
+        params = [p for p in network.parameters()]
+        buffers = list(network.buffers()) if hasattr(network, "buffers") \
+            else []
+
+        def pure_eval(param_arrays, buf_arrays, inputs, label):
+            from paddle_tpu.jit.api import bound_state
+
+            state = params + buffers
+            arrays = list(param_arrays) + list(buf_arrays)
+            with bound_state(zip(state, arrays), state):
+                out = network(*[Tensor._wrap(i) for i in inputs])
+                loss = None
+                if loss_fn is not None and label is not None:
+                    loss = loss_fn(out, Tensor._wrap(label))
+                unwrap = lambda t: t._array if isinstance(t, Tensor) else t
+                return (jax.tree_util.tree_map(
+                            unwrap, out,
+                            is_leaf=lambda t: isinstance(t, Tensor)),
+                        None if loss is None else unwrap(loss))
+
+        # cache is valid only for the mode it was traced in (dropout/BN)
+        return jax.jit(pure_eval), params, buffers, network.training
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        if self._eval_jit is None or \
+                self._eval_jit[3] != self.network.training:
+            self._eval_jit = self._build_eval()
+        fn, params, buffers, _ = self._eval_jit
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out, loss = fn([p._array for p in params],
+                       [b._array for b in buffers],
+                       tuple(_np(i) for i in ins),
+                       None if labels is None else _np(labels))
+        return out, loss
+
+    def predict_batch(self, inputs):
+        out, _ = self.eval_batch(inputs, None)
+        return out
+
+    def _update_metrics(self, out, label):
+        pred = out[0] if isinstance(out, (list, tuple)) else out
+        for m in self._metrics:
+            if hasattr(m, "compute"):
+                m.update(m.compute(Tensor._wrap(_np(pred)),
+                                   None if label is None
+                                   else Tensor._wrap(_np(label))))
+            else:
+                m.update(_np(pred), _np(label))
+
+    def _metric_logs(self):
+        logs = {}
+        for m in self._metrics:
+            v = m.accumulate()
+            logs[m.name() if callable(getattr(m, "name", None)) else m._name] = v
+        return logs
+
+    # -- loops ------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = _to_loader(train_data, batch_size, shuffle, num_workers,
+                            drop_last)
+        eval_loader = _to_loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbl = config_callbacks(callbacks, self, epochs=epochs, steps=steps,
+                               verbose=verbose, log_freq=log_freq,
+                               save_dir=save_dir, save_freq=save_freq,
+                               metrics=self._metrics)
+        self.stop_training = False
+        cbl.call("on_train_begin")
+        logs = {}
+        for epoch in range(epochs):
+            self.network.train()
+            for m in self._metrics:
+                m.reset()
+            cbl.call("on_epoch_begin", epoch)
+            for step, batch in enumerate(loader):
+                cbl.call("on_train_batch_begin", step)
+                ins, label = _split_batch(batch)
+                loss = self.train_batch(ins, label)
+                logs = {"loss": loss, **self._metric_logs()}
+                cbl.call("on_train_batch_end", step, logs)
+            cbl.call("on_epoch_end", epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbl)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            if self.stop_training:
+                break
+        cbl.call("on_train_end", logs)
+        return self
+
+    def _run_eval(self, loader, cbl=None):
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        if cbl:
+            cbl.call("on_eval_begin")
+        losses, n = [], 0
+        for step, batch in enumerate(loader):
+            if cbl:
+                cbl.call("on_eval_batch_begin", step)
+            ins, label = _split_batch(batch)
+            out, loss = self.eval_batch(ins, label)
+            if loss is not None:
+                losses.append(float(loss))
+            self._update_metrics(out, None if label is None
+                                 else Tensor(_np(label)))
+            if cbl:
+                cbl.call("on_eval_batch_end", step,
+                         {"loss": losses[-1] if losses else None})
+        logs = {**({"loss": float(np.mean(losses))} if losses else {}),
+                **self._metric_logs()}
+        if cbl:
+            cbl.call("on_eval_end", logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = _to_loader(eval_data, batch_size, False, num_workers)
+        cbl = config_callbacks(callbacks, self, verbose=verbose,
+                               log_freq=log_freq, metrics=self._metrics)
+        return self._run_eval(loader, cbl)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=True, verbose=1, callbacks=None):
+        loader = _to_loader(test_data, batch_size, False, num_workers)
+        self.network.eval()
+        per_output = None
+        for batch in loader:
+            ins, _ = _split_batch(batch) if isinstance(batch, (list, tuple)) \
+                else ((batch,), None)
+            out = self.predict_batch(ins)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            if per_output is None:
+                per_output = [[] for _ in outs]
+            for slot, o in zip(per_output, outs):
+                slot.append(np.asarray(o))
+        per_output = per_output or []
+        if stack_outputs:
+            return [np.concatenate(slot, axis=0) for slot in per_output]
+        return per_output
+
+    # -- persistence ------------------------------------------------------
+    def save(self, path, training=True):
+        import os
+
+        import paddle_tpu
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        if training:
+            paddle_tpu.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                paddle_tpu.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from paddle_tpu import jit
+
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        import paddle_tpu
+
+        state = paddle_tpu.load(path + ".pdparams")
+        if skip_mismatch:
+            current = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in current and
+                     tuple(np.asarray(_np(v)).shape) ==
+                     tuple(np.asarray(current[k]._array).shape)}
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(paddle_tpu.load(path + ".pdopt"))
+        self._train_step = None
+        self._eval_jit = None

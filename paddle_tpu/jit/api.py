@@ -26,8 +26,27 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from contextlib import contextmanager
+
 from paddle_tpu.core import autograd
 from paddle_tpu.core.tensor import Tensor
+
+
+@contextmanager
+def bound_state(bind_pairs, restore_tensors):
+    """Bind traced arrays into live Tensor objects for the duration of a
+    trace, restoring ALL of restore_tensors after — so in-trace mutations
+    (e.g. BN running stats) can't leak tracers into the eager world. The
+    one bind/restore dance shared by compiled train steps and the hapi
+    eval path."""
+    originals = [t._array for t in restore_tensors]
+    try:
+        for t, a in bind_pairs:
+            t._array = a
+        yield
+    finally:
+        for t, o in zip(restore_tensors, originals):
+            t._array = o
 
 
 class InputSpec:
@@ -213,14 +232,17 @@ def not_to_static(fn):
     return fn
 
 
-def build_step_fn(model, opt, loss_fn, params, acc_idx):
+def build_step_fn(model, opt, loss_fn, params, acc_idx,
+                  with_outputs=False):
     """The ONE compiled-train-step body shared by jit.TrainStep (single
     device) and distributed.DistributedTrainStep (SPMD — which adds
     shardings around it): value_and_grad over the model's eager forward
     with params bound as traced args, grad clip, then the optimizer's
     per-param update. Signature of the returned fn:
     (param_arrays, accums, lr, step, inputs, label, rng) ->
-    (loss, new_params, new_accums)."""
+    (loss, new_params, new_accums) — or with_outputs=True:
+    ((loss, out), new_params, new_accums), the hapi train-metrics path
+    (outputs ride along as value_and_grad aux, no second forward)."""
     from paddle_tpu.core import random as random_mod
 
     opt._ensure_state()
@@ -231,29 +253,24 @@ def build_step_fn(model, opt, loss_fn, params, acc_idx):
     buffers = list(model.buffers()) if hasattr(model, "buffers") else []
 
     def forward_loss(param_arrays, inputs, label, rng):
-        # bind arrays into the live Parameter objects, run eager forward
-        # under trace, restore after. rng is the per-step traced key that
-        # dropout & friends derive from (random.key_scope). Buffers are
-        # restored too so in-trace mutations (BN running stats) can't leak
-        # tracers into the eager world — their updates are dropped inside
-        # compiled steps.
-        originals = [p._array for p in params]
-        buf_originals = [b._array for b in buffers]
-        try:
-            for p, a in zip(params, param_arrays):
-                p._array = a
+        # rng is the per-step traced key that dropout & friends derive
+        # from (random.key_scope); buffer updates are dropped inside
+        # compiled steps (bound_state restores them).
+        with bound_state(zip(params, param_arrays), params + buffers):
             with random_mod.key_scope(rng):
                 out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
                 loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
-            return loss._array if isinstance(loss, Tensor) else loss
-        finally:
-            for p, o in zip(params, originals):
-                p._array = o
-            for b, o in zip(buffers, buf_originals):
-                b._array = o
+            loss_arr = loss._array if isinstance(loss, Tensor) else loss
+            if with_outputs:
+                out_arrs = jax.tree_util.tree_map(
+                    lambda t: t._array if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+                return loss_arr, out_arrs
+            return loss_arr
 
     def step_fn(param_arrays, accums, lr, step, inputs, label, rng):
-        loss, grads = jax.value_and_grad(forward_loss)(
+        loss, grads = jax.value_and_grad(forward_loss,
+                                         has_aux=with_outputs)(
             param_arrays, inputs, label, rng)
         if grad_clip is not None:
             # under pjit the norm reduction is mesh-global: XLA inserts the
@@ -299,10 +316,12 @@ class TrainStep:
     (run_program_op + InterpreterCore) and is what bench.py measures.
     """
 
-    def __init__(self, model, optimizer, loss_fn=None, donate=True):
+    def __init__(self, model, optimizer, loss_fn=None, donate=True,
+                 with_outputs=False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        self.with_outputs = with_outputs
         optimizer._ensure_state()
         # The traced/updated set is the intersection of the model's
         # trainable params (stop_gradient=False — frozen params stay baked
@@ -335,7 +354,8 @@ class TrainStep:
 
     def _make_step_fn(self):
         return build_step_fn(self.model, self.optimizer, self.loss_fn,
-                             self._params, self._acc_idx)
+                             self._params, self._acc_idx,
+                             with_outputs=self.with_outputs)
 
     def run_scan(self, inputs_stacked, labels_stacked):
         """Run a whole sequence of steps inside ONE XLA program via
@@ -362,6 +382,8 @@ class TrainStep:
         return Tensor._wrap(losses)
 
     def _build_scan(self):
+        assert not self.with_outputs, \
+            "run_scan returns losses only; use with_outputs=False"
         base_step = self._make_step_fn()
 
         def scan_all(param_arrays, accums, lr, step0, xs, ys, rng):
@@ -401,4 +423,7 @@ class TrainStep:
             p._in_place_update(a)
         self._scatter_accums(new_accums)
         opt._step_count += 1
+        if self.with_outputs:
+            loss, out = loss
+            return Tensor._wrap(loss), jax.tree_util.tree_map(Tensor._wrap, out)
         return Tensor._wrap(loss)

@@ -1,5 +1,4 @@
-"""paddle.vision analog (python/paddle/vision/). Models land in
-vision/models/; datasets/transforms follow."""
-from . import models, transforms
+"""paddle.vision analog (python/paddle/vision/)."""
+from . import datasets, models, transforms
 
-__all__ = ["models", "transforms"]
+__all__ = ["datasets", "models", "transforms"]

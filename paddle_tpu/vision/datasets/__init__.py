@@ -1,0 +1,137 @@
+"""paddle.vision.datasets analog (python/paddle/vision/datasets/):
+MNIST/FashionMNIST (IDX files) and Cifar10/Cifar100 (pickled batches in
+a tar). This environment has no egress, so download=True raises; point
+image_path/label_path/data_file at local copies (the reference's
+cached-file path) — the parsers read the real formats.
+"""
+from __future__ import annotations
+
+import gzip
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+def _open_maybe_gz(path):
+    if str(path).endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _read_idx(path, magic_want, header_dims):
+    with _open_maybe_gz(path) as f:
+        head = np.frombuffer(f.read(4 * (1 + header_dims)), ">u4")
+        if head[0] != magic_want:
+            raise ValueError(
+                f"{path}: bad IDX magic {head[0]:#x}, want {magic_want:#x}")
+        dims = tuple(int(d) for d in head[1:])
+        data = np.frombuffer(f.read(int(np.prod(dims))), np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (vision/datasets/mnist.py analog). Items:
+    (image [28,28,1] float32 in [0,1] unless backend='raw', label int64).
+    """
+
+    _default_mode_files = {}  # no download cache in this environment
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download and (image_path is None or label_path is None):
+            raise RuntimeError(
+                "no network egress in this environment: pass local "
+                "image_path/label_path (IDX files, optionally .gz)")
+        assert mode in ("train", "test")
+        if image_path is None or label_path is None:
+            raise ValueError("image_path and label_path are required")
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        self.images = _read_idx(image_path, 0x803, 3)  # [N, 28, 28]
+        self.labels = _read_idx(label_path, 0x801, 1)  # [N]
+        if len(self.images) != len(self.labels):
+            raise ValueError(
+                f"images ({len(self.images)}) / labels "
+                f"({len(self.labels)}) count mismatch")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i][..., None]
+        if self.backend != "raw":
+            img = img.astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[i])
+
+
+class FashionMNIST(MNIST):
+    """Same IDX container, different content (fashion_mnist.py)."""
+
+
+class _Cifar(Dataset):
+    _batch_names: tuple = ()
+    _test_names: tuple = ()
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download and data_file is None:
+            raise RuntimeError(
+                "no network egress in this environment: pass a local "
+                "data_file (the cifar tar.gz)")
+        assert mode in ("train", "test")
+        if data_file is None:
+            raise ValueError("data_file is required")
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        names = self._batch_names if mode == "train" else self._test_names
+        imgs, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                base = member.name.rsplit("/", 1)[-1]
+                if base in names:
+                    d = pickle.load(tf.extractfile(member),
+                                    encoding="bytes")
+                    imgs.append(np.asarray(d[b"data"], np.uint8))
+                    labels.extend(int(v) for v in d[self._label_key])
+        if not imgs:
+            raise ValueError(f"{data_file}: no {mode} batches found")
+        self.images = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i].transpose(1, 2, 0)  # HWC like the reference
+        if self.backend != "raw":
+            img = img.astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class Cifar10(_Cifar):
+    """cifar-10-python.tar.gz: data_batch_1..5 + test_batch
+    (vision/datasets/cifar.py analog)."""
+
+    _batch_names = tuple(f"data_batch_{i}" for i in range(1, 6))
+    _test_names = ("test_batch",)
+    _label_key = b"labels"
+
+
+class Cifar100(_Cifar):
+    """cifar-100-python.tar.gz: train + test, fine labels."""
+
+    _batch_names = ("train",)
+    _test_names = ("test",)
+    _label_key = b"fine_labels"

@@ -93,8 +93,16 @@ class StaticFunction:
 
     def __init__(self, fn, input_spec=None, build_strategy=None, backend=None,
                  layer=None):
-        self._fn = fn
-        self._input_spec = input_spec
+        # data-dependent if/while become lax.cond/while_loop (dy2static
+        # AST pass; python-bool conditions keep python semantics)
+        from paddle_tpu.jit.dy2static import transform_function
+
+        self._fn = transform_function(fn)
+        self._input_spec = list(input_spec) if input_spec else None
+        self._bucket_dynamic = bool(
+            (build_strategy or {}).get("dynamic_dim_buckets")
+            if isinstance(build_strategy, dict) else
+            getattr(build_strategy, "dynamic_dim_buckets", False))
         self._layer = layer
         if layer is None and inspect.ismethod(fn):
             from paddle_tpu.nn.layer import Layer
@@ -103,6 +111,81 @@ class StaticFunction:
                 self._layer = fn.__self__
         self._cache = {}  # spec key -> jitted callable
         functools.update_wrapper(self, fn)
+
+    def _spec_tensors(self, args, kwargs):
+        """Array-like inputs in parameter order (kwarg tensors included,
+        via signature binding)."""
+        if kwargs:
+            try:
+                ba = inspect.signature(self._fn).bind(*args, **kwargs)
+                flat = list(ba.arguments.values())
+            except TypeError:
+                flat = list(args) + list(kwargs.values())
+        else:
+            flat = list(args)
+        return [a for a in flat if _is_arraylike(a)]
+
+    def _check_spec(self, args, kwargs):
+        """input_spec is a contract, not a hint (program_translator.py:519
+        spec-driven concretization): ranks/dtypes/fixed dims must match;
+        None/-1/named dims accept any size."""
+        tensors = self._spec_tensors(args, kwargs)
+        if len(tensors) < len(self._input_spec):
+            raise ValueError(
+                f"to_static input_spec expects {len(self._input_spec)} "
+                f"tensor inputs, got {len(tensors)}")
+        for n, (s, a) in enumerate(zip(self._input_spec, tensors)):
+            arr = a._array if isinstance(a, Tensor) else np.asarray(a)
+            if len(arr.shape) != len(s.shape):
+                raise ValueError(
+                    f"input {n}: rank {len(arr.shape)} != input_spec rank "
+                    f"{len(s.shape)} {tuple(s.shape)}")
+            want = str(jnp.dtype(s.dtype if s.dtype is not None
+                                 else "float32"))
+            if str(arr.dtype) != want:
+                raise TypeError(
+                    f"input {n}: dtype {arr.dtype} != input_spec dtype "
+                    f"{want}")
+            for ax, d in enumerate(s.shape):
+                if isinstance(d, int) and d >= 0 and arr.shape[ax] != d:
+                    raise ValueError(
+                        f"input {n}: dim {ax} is {arr.shape[ax]}, "
+                        f"input_spec requires {d}")
+
+    def _bucket_args(self, args, kwargs):
+        """Pad AXIS-0 dynamic-spec dims up to the next power of two so N
+        batch sizes share one compiled program (TPU dynamic-batch
+        bucketing); outputs carrying the padded size on axis 0 are sliced
+        back by the caller. Dynamic dims on other axes stay unpadded
+        (each size gets its own trace). Opt-in, with two caveats: math
+        that mixes rows across the batch (e.g. a mean over axis 0) sees
+        the zero-pad rows, and a fixed-size output whose leading dim
+        coincidentally equals the bucket size would be mis-sliced."""
+        if kwargs:
+            raise ValueError(
+                "dynamic_dim_buckets requires the spec'd tensors to be "
+                "passed positionally")
+        arr_pos = [i for i, a in enumerate(args) if _is_arraylike(a)]
+        args = list(args)
+        orig = padded = None
+        for s, i in zip(self._input_spec, arr_pos):
+            if not s.shape:
+                continue
+            d = s.shape[0]
+            if not (d is None or isinstance(d, str) or
+                    (isinstance(d, int) and d < 0)):
+                continue
+            a = args[i]
+            arr = a._array if isinstance(a, Tensor) else jnp.asarray(a)
+            n = arr.shape[0]
+            b = 1 << max(n - 1, 0).bit_length() if n & (n - 1) else n
+            orig, padded = n, b
+            if b != n:
+                widths = [(0, b - n)] + [(0, 0)] * (arr.ndim - 1)
+                arr = jnp.pad(arr, widths)
+                args[i] = Tensor._wrap(arr) if isinstance(a, Tensor) else arr
+        return tuple(args), (orig, padded) if orig is not None and \
+            padded != orig else None
 
     @property
     def concrete_programs(self):
@@ -114,6 +197,27 @@ class StaticFunction:
         return list(self._layer.parameters()) + list(self._layer.buffers())
 
     def __call__(self, *args, **kwargs):
+        bucket = None
+        if self._input_spec:
+            self._check_spec(args, kwargs)
+            if self._bucket_dynamic:
+                args, bucket = self._bucket_args(args, kwargs)
+        out = self._call_impl(args, kwargs)
+        if bucket is not None:
+            orig, padded = bucket
+
+            def unslice(t):
+                arr = t._array if isinstance(t, Tensor) else t
+                if hasattr(arr, "shape") and arr.ndim >= 1 and \
+                        arr.shape[0] == padded:
+                    return t[:orig] if isinstance(t, Tensor) \
+                        else arr[:orig]
+                return t
+            out = jax.tree_util.tree_map(
+                unslice, out, is_leaf=lambda t: isinstance(t, Tensor))
+        return out
+
+    def _call_impl(self, args, kwargs):
         state = self._live_state()
         # key includes the state object identities: layer surgery that
         # REPLACES a Parameter (vs mutating it) must retrace, otherwise
@@ -218,7 +322,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
         from paddle_tpu.nn.layer import Layer
 
         if isinstance(fn, Layer):
-            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn)
+            fn.forward = StaticFunction(fn.forward, input_spec,
+                                        build_strategy, backend, layer=fn)
             return fn
         return StaticFunction(fn, input_spec, build_strategy, backend)
 

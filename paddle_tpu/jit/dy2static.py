@@ -1,0 +1,422 @@
+"""dy2static — data-dependent Python control flow under to_static.
+
+Reference analog: python/paddle/jit/dy2static/ (program_translator.py
+AST pipeline, convert_operators.py convert_ifelse/convert_while_loop).
+The reference rewrites `if`/`while` whose conditions are Tensors into
+cond/while ops inside the ProgramDesc; here the same AST rewrite targets
+jax: conditions that turn out to be TRACED arrays run as lax.cond /
+lax.while_loop (compiler-friendly, both branches staged), while plain
+Python bools keep exact Python semantics via runtime dispatch — one
+transform serves eager calls, jit.to_static, and TrainStep tracing.
+
+Scope: if/elif/else and while whose bodies assign local names, and
+branches that both return. Constructs outside it (break/continue,
+one-sided returns, while/else) keep Python semantics — correct for bool
+conditions, and a Tensor condition then fails loudly at bool(tracer)
+rather than silently changing control flow. Reverse-mode AD through a
+tensor-`while` is a JAX limit (lax.while_loop is not transposable) —
+training through one raises jax's precise error; tensor-`if` (lax.cond)
+differentiates fine.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["convert_ifelse", "convert_while_loop",
+           "transform_function", "UNDEF"]
+
+
+class _Undefined:
+    """Sentinel for names defined in only some branches (the reference's
+    UndefinedVar)."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError("variable is undefined on this branch")
+
+
+UNDEF = _Undefined()
+
+
+def init_undef(thunk):
+    """`x = _paddle_jst.init_undef(lambda: x)` — UNDEF when x is not yet bound."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _cond_value(cond):
+    if isinstance(cond, Tensor):
+        cond = cond._array
+    return cond
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor._wrap(a) if isinstance(a, (jax.Array,)) or
+        _is_traced(a) else a, tree)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t._array if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _is_carried(x, numbers=False):
+    """Can x ride a lax.cond/while_loop operand? UNDEF and arbitrary
+    python objects travel by closure instead."""
+    import numpy as _np
+
+    if isinstance(x, (Tensor, jax.Array, _np.ndarray)) or _is_traced(x):
+        return True
+    return numbers and isinstance(x, (bool, int, float))
+
+
+def _unwrap_one(x):
+    if isinstance(x, Tensor):
+        return x._array
+    return x
+
+
+def _wrap_one(x):
+    if isinstance(x, (jax.Array,)) or _is_traced(x):
+        return Tensor._wrap(x)
+    return x
+
+
+def convert_ifelse(cond, true_fn, false_fn, args):
+    """convert_operators.convert_ifelse analog. `args` is the tuple of
+    branch-carried locals; both fns take and return that tuple."""
+    v = _cond_value(cond)
+    if not _is_traced(v):
+        return true_fn(*args) if bool(v) else false_fn(*args)
+
+    # traced: stage BOTH branches as lax.cond. Array-like vars cross the
+    # boundary as the operand; UNDEF / python values go by closure (a
+    # branch assigning them puts the new value in the OUTPUT tree, which
+    # lax.cond checks for cross-branch agreement).
+    dyn = [i for i, a in enumerate(args) if _is_carried(a)]
+    template = list(args)
+
+    def stage(fn):
+        def staged(operand):
+            full = list(template)
+            for i, a in zip(dyn, operand):
+                full[i] = _wrap_one(a)
+            return _unwrap_tree(tuple(fn(*full)))
+        return staged
+
+    operand = tuple(_unwrap_one(args[i]) for i in dyn)
+    try:
+        out = jax.lax.cond(jnp.asarray(v).astype(bool),
+                           stage(true_fn), stage(false_fn), operand)
+    except TypeError as e:
+        raise TypeError(
+            "tensor-dependent `if`: both branches must produce the same "
+            f"variables with matching shapes/dtypes ({e})") from e
+    return tuple(_wrap_one(o) for o in out)
+
+
+def convert_while_loop(cond_fn, body_fn, args):
+    """convert_operators.convert_while_loop analog."""
+    v = _cond_value(cond_fn(*args))
+    if not _is_traced(v):
+        # eager: plain python loop (each iteration re-evaluates concretely)
+        while bool(_cond_value(cond_fn(*args))):
+            args = body_fn(*args)
+        return args
+
+    # numbers must join the carry: loop counters evolve across iterations
+    dyn = [i for i, a in enumerate(args) if _is_carried(a, numbers=True)]
+    template = list(args)
+
+    def rebuild(operand):
+        full = list(template)
+        for i, a in zip(dyn, operand):
+            full[i] = _wrap_one(a)
+        return full
+
+    def c(operand):
+        return jnp.asarray(_cond_value(cond_fn(*rebuild(operand)))) \
+            .astype(bool)
+
+    def b(operand):
+        out = tuple(body_fn(*rebuild(operand)))
+        return tuple(_unwrap_one(out[i]) for i in dyn)
+
+    operand = tuple(jnp.asarray(_unwrap_one(args[i])) for i in dyn)
+    try:
+        out = jax.lax.while_loop(c, b, operand)
+    except TypeError as e:
+        raise TypeError(
+            "tensor-dependent `while`: loop variables must keep the same "
+            f"shapes/dtypes across iterations ({e})") from e
+    full = rebuild(out)
+    return tuple(full)
+
+
+# ---------------------------------------------------------------------------
+# the AST transform (program_translator / ifelse_transformer analog)
+# ---------------------------------------------------------------------------
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list (branch-carried variables)."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        # local defs (incl. generated __jst_* helpers) can't cross a
+        # lax.cond boundary; they stay branch-local — don't descend
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _contains(stmts, kinds):
+    """Like ast.walk but stops at nested function/lambda boundaries, so a
+    `return` inside a local def doesn't count as a branch return."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, kinds):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _all_paths_return(stmts):
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _name(self, base):
+        self.counter += 1
+        return f"__jst_{base}_{self.counter}"
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains(node.body + node.orelse, (ast.Break, ast.Continue)):
+            return node  # loop-control of an enclosing python loop
+        if _all_paths_return(node.body) and node.orelse and \
+                _all_paths_return(node.orelse):
+            return self._rewrite_returning_if(node)
+        if _contains(node.body + node.orelse, (ast.Return,)):
+            # one-sided/mid-branch return: keep python semantics (fails
+            # loudly under trace — bool() on a tracer — rather than
+            # silently changing control flow)
+            return node
+        return self._rewrite_assigning_if(node)
+
+    def _branch_fn(self, name, stmts, vars_):
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in vars_],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in vars_],
+            ctx=ast.Load()))
+        return ast.FunctionDef(name=name, args=args,
+                               body=list(stmts) + [ret],
+                               decorator_list=[], returns=None)
+
+    def _rewrite_assigning_if(self, node):
+        vars_ = sorted(_assigned(node.body) | _assigned(node.orelse))
+        tname, fname = self._name("true"), self._name("false")
+        out = []
+        # seed possibly-unbound carried vars with UNDEF
+        for v in vars_:
+            out.append(ast.parse(
+                f"{v} = _paddle_jst.init_undef(lambda: {v})").body[0])
+        out.append(self._branch_fn(tname, node.body, vars_))
+        out.append(self._branch_fn(
+            fname, node.orelse or [ast.Pass()], vars_))
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_paddle_jst", ctx=ast.Load()),
+                               attr="convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                  for v in vars_], ctx=ast.Load())],
+            keywords=[])
+        if vars_:
+            tgt = ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Store())
+                                  for v in vars_], ctx=ast.Store()) \
+                if len(vars_) > 1 else ast.Name(id=vars_[0], ctx=ast.Store())
+            out.append(ast.Assign(
+                targets=[tgt],
+                value=call if len(vars_) > 1 else
+                ast.Subscript(value=call,
+                              slice=ast.Constant(value=0), ctx=ast.Load())))
+        else:
+            out.append(ast.Expr(value=call))
+        return out
+
+    def _rewrite_returning_if(self, node):
+        tname, fname = self._name("true"), self._name("false")
+
+        class _TupleReturns(ast.NodeTransformer):
+            def visit_FunctionDef(self, n):
+                return n  # don't descend
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+            visit_Lambda = visit_FunctionDef
+
+            def visit_Return(self, n):
+                val = n.value or ast.Constant(value=None)
+                return ast.Return(value=ast.Tuple(elts=[val],
+                                                  ctx=ast.Load()))
+
+        def as_fn(name, stmts):
+            stmts = [_TupleReturns().visit(s) for s in stmts]
+            args = ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                 kw_defaults=[], defaults=[])
+            return ast.FunctionDef(name=name, args=args, body=list(stmts),
+                                   decorator_list=[], returns=None)
+
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_paddle_jst", ctx=ast.Load()),
+                               attr="convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Tuple(elts=[], ctx=ast.Load())],
+            keywords=[])
+        ret = ast.Return(value=ast.Subscript(
+            value=call, slice=ast.Constant(value=0), ctx=ast.Load()))
+        return [as_fn(tname, node.body), as_fn(fname, node.orelse), ret]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node  # while/else: python semantics
+        if _contains(node.body, (ast.Break, ast.Continue, ast.Return)):
+            # python semantics; a Tensor condition then fails loudly at
+            # bool(tracer) instead of silently changing control flow
+            return node
+        vars_ = sorted(_assigned(node.body))
+        if not vars_:
+            return node
+        cname, bname = self._name("cond"), self._name("body")
+        out = []
+        for v in vars_:
+            out.append(ast.parse(
+                f"{v} = _paddle_jst.init_undef(lambda: {v})").body[0])
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in vars_],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        out.append(ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None))
+        out.append(self._branch_fn(bname, node.body, vars_))
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_paddle_jst", ctx=ast.Load()),
+                               attr="convert_while_loop", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                  for v in vars_], ctx=ast.Load())],
+            keywords=[])
+        tgt = ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Store())
+                              for v in vars_], ctx=ast.Store()) \
+            if len(vars_) > 1 else ast.Name(id=vars_[0], ctx=ast.Store())
+        out.append(ast.Assign(
+            targets=[tgt],
+            value=call if len(vars_) > 1 else
+            ast.Subscript(value=call, slice=ast.Constant(value=0),
+                          ctx=ast.Load())))
+        return out
+
+
+def transform_function(fn):
+    """Rewrite fn's if/while into convert_* calls. Returns the original
+    on anything untransformable (source unavailable, exotic constructs) —
+    the reference's fallback-to-original behavior."""
+    raw = getattr(fn, "__func__", fn)
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _contains(fdef.body, (ast.If, ast.While)):
+        return fn
+    fdef.decorator_list = []
+    _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+
+    # re-exec inside a factory that rebinds the original free variables
+    freevars = raw.__code__.co_freevars
+    factory_name = "__jst_factory"
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=ast.arguments(posonlyargs=[],
+                           args=[ast.arg(arg=v) for v in freevars],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                              ctx=ast.Load()))],
+        decorator_list=[], returns=None)
+    mod = ast.fix_missing_locations(ast.Module(body=[factory],
+                                               type_ignores=[]))
+    import paddle_tpu.jit.dy2static as _jst_mod
+
+    # exec against the LIVE module globals (not a snapshot) so later
+    # rebinding of module-level names stays visible to the transformed
+    # function; only the prefixed helper binding is added
+    glb = raw.__globals__
+    glb["_paddle_jst"] = _jst_mod
+    try:
+        code = compile(mod, filename=f"<dy2static {raw.__name__}>",
+                       mode="exec")
+        exec(code, glb)
+        cells = [c.cell_contents for c in (raw.__closure__ or ())]
+        new = glb.pop(factory_name)(*cells)
+    except Exception:
+        glb.pop(factory_name, None)
+        return fn  # transform must never break a function that ran before
+    functools.update_wrapper(new, raw)
+    new.__jst_transformed__ = True
+    if inspect.ismethod(fn):
+        return new.__get__(fn.__self__, type(fn.__self__))
+    return new

@@ -1,0 +1,183 @@
+"""Per-op microbenchmark harness — analog of the reference's op_tester
+(paddle/fluid/operators/benchmark/op_tester.cc) + ci benchmark gate.
+
+Times a fixed suite of core ops as jitted XLA programs on the current
+backend (the real TPU chip under axon; CPU elsewhere), prints one JSON
+line per op, and can gate regressions against a stored baseline:
+
+    python bench_ops.py                         # run + print
+    python bench_ops.py --save OPBENCH.json     # record baseline
+    python bench_ops.py --check OPBENCH.json    # exit 1 on >25% regress
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timeit(fn, *args, n_small=4, n_big=16):
+    """Tunnel-proof timing. Per-dispatch timing is useless over the axon
+    TPU tunnel: dispatch latency dominates, async completion is opaque
+    to block_until_ready, and repeat dispatches of the same executable
+    on the same buffers can be served memoized (~0 ms). So each
+    measurement runs N iterations of the op INSIDE one lax.scan program
+    (inputs salted per-iteration so nothing is loop-invariant, outputs
+    folded into a scalar carry so every iteration is on the data path),
+    forced by a 4-byte host read. Timing the same program at two N and
+    taking the slope cancels the fixed dispatch+transfer overhead."""
+
+    def salted(a, s):
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact):
+            return a + s.astype(a.dtype)
+        return a
+
+    def scalarize(out):
+        leaves = jax.tree_util.tree_leaves(out)
+        return sum(jnp.sum(l).astype(jnp.float32) for l in leaves
+                   if hasattr(l, "dtype") and
+                   jnp.issubdtype(l.dtype, jnp.inexact))
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames="n")
+    def many(salt, args, n):
+        def body(c, i):
+            varied = tuple(salted(a, i + salt) for a in args)
+            return c + scalarize(fn(*varied)), None
+        tot, _ = jax.lax.scan(body, jnp.float32(0.0),
+                              jnp.arange(n, dtype=jnp.float32))
+        return tot
+
+    def run_once(salt, n):
+        t0 = time.perf_counter()
+        float(many(jnp.float32(salt), args, n))
+        return time.perf_counter() - t0
+
+    salt = [0.0]
+
+    def best(n, reps=3):
+        ts = []
+        for _ in range(reps):
+            salt[0] += 1.0
+            ts.append(run_once(salt[0], n))
+        return min(ts)
+
+    best(n_small, reps=1)  # compile both shapes before timing
+    best(n_big, reps=1)
+    t_small, t_big = best(n_small), best(n_big)
+    return max(t_big - t_small, 1e-9) / (n_big - n_small) * 1e3  # ms
+
+
+def _rand(shape, dtype=jnp.bfloat16, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32) \
+        .astype(dtype)
+
+
+def suite():
+    """name -> (fn, args, flops-or-None). Shapes sized for one chip."""
+    import paddle_tpu  # noqa: F401  (registers pallas kernels)
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, S, H, D = 4, 2048, 16, 128
+    M = 4096
+    cases = {}
+
+    x = _rand((M, M))
+    w = _rand((M, M), seed=1)
+    cases["matmul_4096_bf16"] = (
+        jax.jit(lambda a, b: a @ b), (x, w), 2 * M ** 3)
+
+    img = _rand((32, 224, 224, 3))
+    ker = _rand((7, 7, 3, 64), seed=2)
+    cases["conv2d_7x7_s2"] = (
+        jax.jit(lambda i, k: jax.lax.conv_general_dilated(
+            i, k, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))),
+        (img, ker),
+        2 * 32 * 112 * 112 * 64 * 7 * 7 * 3)
+
+    q = _rand((B, S, H, D))
+    k = _rand((B, S, H, D), seed=3)
+    v = _rand((B, S, H, D), seed=4)
+    cases["flash_attention_2k"] = (
+        jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True)),
+        (q, k, v), 4 * B * H * S * S * D // 2)
+
+    h = _rand((B * S, M // 2))
+    g = _rand((M // 2,))
+    b2 = _rand((M // 2,), seed=5)
+    cases["layernorm_2048"] = (
+        jax.jit(lambda a, gg, bb: (a - a.mean(-1, keepdims=True))
+                / jnp.sqrt(a.var(-1, keepdims=True) + 1e-5) * gg + bb),
+        (h, g, b2), None)
+
+    logits = _rand((2048, 50304), jnp.float32)
+    cases["softmax_xent_50k"] = (
+        jax.jit(lambda lg: -jax.nn.log_softmax(lg)[:, 0].mean()),
+        (logits,), None)
+
+    tbl = _rand((50304, 2048))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 50304, B * S))
+    cases["embedding_50k"] = (
+        jax.jit(lambda t, i: t[i]), (tbl, ids), None)
+
+    big = _rand((64, 1 << 20))
+    cases["reduce_sum_64M"] = (
+        jax.jit(lambda a: a.astype(jnp.float32).sum()), (big,), None)
+    return cases
+
+
+def run():
+    results = {}
+    for name, (fn, args, flops) in suite().items():
+        ms = _timeit(fn, *args)
+        rec = {"op": name, "ms": round(ms, 4)}
+        if flops:
+            rec["tflops"] = round(flops / (ms / 1e3) / 1e12, 2)
+        results[name] = rec
+        print(json.dumps(rec), flush=True)
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--save", metavar="FILE")
+    ap.add_argument("--check", metavar="FILE")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="allowed fractional slowdown vs baseline")
+    args = ap.parse_args()
+    results = run()
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"baseline saved to {args.save}")
+    if args.check:
+        with open(args.check) as f:
+            base = json.load(f)
+        failed = []
+        for name, rec in results.items():
+            if name in base:
+                slow = rec["ms"] / base[name]["ms"] - 1.0
+                if slow > args.threshold:
+                    failed.append(f"{name}: {slow:+.0%} vs baseline "
+                                  f"({rec['ms']}ms vs {base[name]['ms']}ms)")
+        # a silently-skipped op is a disabled gate, not a pass
+        for name in sorted(set(results) - set(base)):
+            failed.append(f"{name}: not in baseline (refresh with --save)")
+        for name in sorted(set(base) - set(results)):
+            failed.append(f"{name}: in baseline but not measured")
+        if failed:
+            print("REGRESSION GATE FAILED:\n  " + "\n  ".join(failed))
+            sys.exit(1)
+        print(f"regression gate ok ({len(results)} ops, "
+              f"threshold {args.threshold:.0%})")
+
+
+if __name__ == "__main__":
+    main()

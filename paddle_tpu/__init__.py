@@ -83,6 +83,7 @@ from paddle_tpu import io  # noqa: E402
 from paddle_tpu import jit  # noqa: E402
 from paddle_tpu import distributed  # noqa: E402
 from paddle_tpu.framework.io import load, save  # noqa: E402
+from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: E402
 from paddle_tpu import device  # noqa: E402
 from paddle_tpu import vision  # noqa: E402
 from paddle_tpu import metric  # noqa: E402

@@ -187,8 +187,12 @@ class DistributedTrainStep:
             inputs = tuple(inputs)
         if not self._placed:
             self.place_params()
-        if self._jitted is None:
+        from paddle_tpu.framework.flags import debug_epoch
+
+        if self._jitted is None or \
+                getattr(self, "_flags_epoch", None) != debug_epoch():
             self._jitted = self._build()
+            self._flags_epoch = debug_epoch()
         opt = self.optimizer
         mesh = self.hcg.mesh
         bs = NamedSharding(mesh, P(self.batch_axes))

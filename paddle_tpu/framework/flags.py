@@ -1,0 +1,77 @@
+"""Global FLAGS registry — analog of the reference's gflags-style flag
+system (paddle/utils/flags.h, python paddle.set_flags/get_flags via
+pybind GlobalVarGetterSetterRegistry). Flags initialize from the
+environment (FLAGS_xxx=1, the reference's export convention).
+
+Debug flags wired in:
+  FLAGS_check_nan_inf        — eager ops AND compiled train steps verify
+                               outputs/grads are finite
+                               (fluid/eager/nan_inf_utils.h:37 analog;
+                               inside compiled programs this stages a
+                               jax.debug.callback, SURVEY §7 hard-part)
+  FLAGS_check_nan_inf_level  — 0: raise on nan/inf; 3: warn only
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["set_flags", "get_flags"]
+
+_DEFAULTS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_cudnn_deterministic": False,   # accepted for parity; XLA on
+    "FLAGS_embedding_deterministic": 0,   # TPU is deterministic already
+}
+
+
+def _coerce(name, value):
+    proto = _DEFAULTS[name]
+    if isinstance(proto, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(proto, int):
+        return int(value)
+    return value
+
+
+_FLAGS = {k: _coerce(k, os.environ[k]) if k in os.environ else v
+          for k, v in _DEFAULTS.items()}
+
+
+_EPOCH = [0]
+
+
+def debug_epoch():
+    """Bumped by set_flags. Compiled-program caches (TrainStep,
+    StaticFunction, hapi eval) key on this so flag changes take effect
+    on already-compiled paths — flags are read at trace time, so a stale
+    cache would silently ignore a toggle."""
+    return _EPOCH[0]
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity: {'FLAGS_check_nan_inf': 1}."""
+    for k, v in flags.items():
+        if k not in _DEFAULTS:
+            raise ValueError(f"unknown flag {k!r}; known: "
+                             f"{sorted(_DEFAULTS)}")
+        _FLAGS[k] = _coerce(k, v)
+    _EPOCH[0] += 1
+
+
+def get_flags(flags):
+    """paddle.get_flags parity: name or list of names -> dict."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    for k in names:
+        if k not in _FLAGS:
+            raise ValueError(f"unknown flag {k!r}")
+        out[k] = _FLAGS[k]
+    return out
+
+
+def flag(name):
+    """Fast internal read."""
+    return _FLAGS[name]

@@ -1,0 +1,64 @@
+"""NaN/Inf debugging — analog of FLAGS_check_nan_inf
+(paddle/fluid/eager/nan_inf_utils.h:37 CheckTensorHasNanOrInf, legacy
+framework/details/nan_inf_utils_detail.*).
+
+Eager ops check concrete outputs directly. Inside compiled programs
+(TrainStep, to_static, run_scan) the check is STAGED: finiteness flags
+are computed in-graph (cheap fused reductions) and a jax.debug.callback
+raises host-side with the offending names — the SURVEY §7 "debug inside
+compiled programs" hard-part. Enable with
+paddle.set_flags({'FLAGS_check_nan_inf': 1}); level 3 warns instead of
+raising.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .flags import flag
+
+__all__ = ["check_enabled", "check_eager", "stage_check"]
+
+
+def check_enabled():
+    return flag("FLAGS_check_nan_inf")
+
+
+def _report(bad_names, where):
+    msg = (f"nan/inf detected in {where}: {', '.join(bad_names)} "
+           "(FLAGS_check_nan_inf)")
+    if flag("FLAGS_check_nan_inf_level") >= 3:
+        warnings.warn(msg)
+    else:
+        raise FloatingPointError(msg)
+
+
+def check_eager(op_name, arrays):
+    """Concrete (non-tracer) outputs of one eager op."""
+    bad = [f"output[{i}]" for i, a in enumerate(arrays)
+           if jnp.issubdtype(a.dtype, jnp.inexact) and
+           not bool(jnp.isfinite(a).all())]
+    if bad:
+        _report(bad, f"op '{op_name}'")
+
+
+def stage_check(named_arrays, where):
+    """Inside a trace: stage finite-checks + one host callback. The
+    in-graph part is a per-tensor all-finite reduction (XLA fuses these);
+    the callback only sees booleans, so the hot data never leaves HBM."""
+    named = [(n, a) for n, a in named_arrays
+             if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.inexact)]
+    if not named:
+        return
+    flags = jnp.stack([jnp.isfinite(a).all() for _, a in named])
+    names = [n for n, _ in named]
+
+    def cb(ok):
+        ok = np.asarray(ok)
+        if not ok.all():
+            _report([n for n, o in zip(names, ok) if not o], where)
+
+    jax.debug.callback(cb, flags)

@@ -123,13 +123,19 @@ class Model:
                             is_leaf=lambda t: isinstance(t, Tensor)),
                         None if loss is None else unwrap(loss))
 
-        # cache is valid only for the mode it was traced in (dropout/BN)
-        return jax.jit(pure_eval), params, buffers, network.training
+        # cache is valid only for the mode (dropout/BN) + debug-flag
+        # epoch it was traced in
+        from paddle_tpu.framework.flags import debug_epoch
+
+        return (jax.jit(pure_eval), params, buffers,
+                (network.training, debug_epoch()))
 
     def eval_batch(self, inputs, labels=None):
+        from paddle_tpu.framework.flags import debug_epoch
+
         self.network.eval()
         if self._eval_jit is None or \
-                self._eval_jit[3] != self.network.training:
+                self._eval_jit[3] != (self.network.training, debug_epoch()):
             self._eval_jit = self._build_eval()
         fn, params, buffers, _ = self._eval_jit
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]

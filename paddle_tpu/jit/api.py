@@ -223,8 +223,10 @@ class StaticFunction:
         # REPLACES a Parameter (vs mutating it) must retrace, otherwise
         # pure_fn would bind arrays into dead objects and bake the new
         # object's value as a constant
+        from paddle_tpu.framework.flags import debug_epoch
+
         key = (_spec_of(args), _spec_of(tuple(sorted(kwargs.items()))),
-               tuple(id(t) for t in state))
+               tuple(id(t) for t in state), debug_epoch())
         entry = self._cache.get(key)
         if entry is None:
             entry = [self._build(args, kwargs, state), None]  # [jitted, tape_ok]
@@ -377,6 +379,16 @@ def build_step_fn(model, opt, loss_fn, params, acc_idx,
         loss, grads = jax.value_and_grad(forward_loss,
                                          has_aux=with_outputs)(
             param_arrays, inputs, label, rng)
+        from paddle_tpu.framework import nan_inf
+
+        if nan_inf.check_enabled():
+            # FLAGS_check_nan_inf inside the compiled step: loss + every
+            # grad, named, via one staged host callback (SURVEY §7)
+            loss_arr = loss[0] if with_outputs else loss
+            named = [("loss", loss_arr)] + [
+                (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
+                for i, (p, g) in enumerate(zip(params, grads))]
+            nan_inf.stage_check(named, "compiled train step")
         if grad_clip is not None:
             # under pjit the norm reduction is mesh-global: XLA inserts the
             # cross-shard collectives (hybrid_parallel_optimizer.py:186)
@@ -468,9 +480,13 @@ class TrainStep:
         steps. inputs/labels have a leading [num_steps] dim. Returns the
         per-step losses. (The analog of the reference's
         Executor.train_from_dataset inner loop, compiled.)"""
-        if self._scan_jitted is None:
+        from paddle_tpu.framework.flags import debug_epoch
+
+        if self._scan_jitted is None or \
+                getattr(self, "_scan_epoch", None) != debug_epoch():
             self.optimizer._ensure_state()
             self._scan_jitted = self._build_scan()
+            self._scan_epoch = debug_epoch()
         opt = self.optimizer
         param_arrays = [p._array for p in self._params]
         accums = self._gather_accums()
@@ -511,9 +527,14 @@ class TrainStep:
         if label is None and len(inputs) >= 2:
             *inputs, label = inputs
             inputs = tuple(inputs)
-        if self._jitted is None:
+        from paddle_tpu.framework.flags import debug_epoch
+
+        if self._jitted is None or \
+                getattr(self, "_flags_epoch", None) != debug_epoch():
             self.optimizer._ensure_state()
             self._jitted = self._build()
+            self._scan_jitted = None
+            self._flags_epoch = debug_epoch()
         opt = self.optimizer
         param_arrays = [p._array for p in self._params]
         accums = self._gather_accums()

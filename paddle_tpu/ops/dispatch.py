@@ -39,6 +39,25 @@ class OpStats:
             cls.counts[name] = cls.counts.get(name, 0) + 1
 
 
+def _maybe_check_numerics(op_name, arrays):
+    """FLAGS_check_nan_inf hook (nan_inf_utils.h:37 analog): checks every
+    op's outputs when the debug flag is on — concrete arrays host-side,
+    tracer outputs via a staged in-graph check."""
+    from paddle_tpu.framework import nan_inf
+
+    if not nan_inf.check_enabled():
+        return
+    concrete = [a for a in arrays if not isinstance(a, jax.core.Tracer)
+                and hasattr(a, "dtype")]
+    traced = [a for a in arrays if isinstance(a, jax.core.Tracer)]
+    if concrete:
+        nan_inf.check_eager(op_name, concrete)
+    if traced:
+        nan_inf.stage_check(
+            [(f"output[{i}]", a) for i, a in enumerate(traced)],
+            f"op '{op_name}'")
+
+
 def as_tensor(x, ref: Tensor = None) -> Tensor:
     """Coerce scalars / arrays to Tensor. Python scalars adopt the ref
     tensor's dtype (paddle scalar-promotion semantics: `x * 2.0` keeps
@@ -60,9 +79,10 @@ def unwrap(x):
     return x
 
 
-def _wrap_outputs(out_arrays, node, needs_grad):
+def _wrap_outputs(out_arrays, node, needs_grad, op_name=None):
     single = not isinstance(out_arrays, (tuple, list))
     outs = [out_arrays] if single else list(out_arrays)
+    _maybe_check_numerics(op_name or (node.name if node else "op"), outs)
     tensors = []
     for i, arr in enumerate(outs):
         diffable = needs_grad and jnp.issubdtype(arr.dtype, jnp.inexact)
@@ -93,7 +113,7 @@ def apply(name: str, fn: Callable, *inputs: Tensor, amp_policy: str = None):
     )
     if not needs_grad:
         out = fn(*arrays)
-        return _wrap_outputs(out, None, False)
+        return _wrap_outputs(out, None, False, op_name=name)
 
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
         # Inside an outer jax trace (TrainStep's value_and_grad, to_static,
@@ -126,4 +146,4 @@ def apply_nograd(name: str, fn: Callable, *inputs: Tensor):
     OpStats.record(name)
     arrays = [t._array for t in inputs]
     out = fn(*arrays)
-    return _wrap_outputs(out, None, False)
+    return _wrap_outputs(out, None, False, op_name=name)

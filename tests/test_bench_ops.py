@@ -1,0 +1,48 @@
+"""op μbench harness tests (VERDICT r2 #10): slope-based timing returns
+sane values and the regression gate trips correctly.
+
+Reference analog: paddle/fluid/operators/benchmark/op_tester.cc +
+tools/ci benchmark gating.
+"""
+import json
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+import bench_ops
+
+
+def test_timeit_measures_real_work():
+    import jax
+
+    f = jax.jit(lambda a: jnp.tanh(a @ a.T).sum()[None])
+    x = jnp.ones((256, 256), jnp.float32)
+    ms = bench_ops._timeit(f, x, n_small=2, n_big=6)
+    assert 0 < ms < 1000
+
+
+def test_regression_gate(tmp_path, monkeypatch):
+    fake = {"op_a": {"op": "op_a", "ms": 1.0}, "op_b": {"op": "op_b",
+                                                        "ms": 2.0}}
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(fake))
+
+    # simulate a 2x regression on op_a via a fake run()
+    slow = {"op_a": {"op": "op_a", "ms": 2.0}, "op_b": {"op": "op_b",
+                                                        "ms": 2.0}}
+    monkeypatch.setattr(bench_ops, "run", lambda: slow)
+    monkeypatch.setattr(sys, "argv", ["bench_ops.py", "--check", str(base)])
+    try:
+        bench_ops.main()
+        raised = False
+    except SystemExit as e:
+        raised = e.code == 1
+    assert raised, "gate must fail on a 100% regression"
+
+    # within threshold passes
+    ok = {"op_a": {"op": "op_a", "ms": 1.1}, "op_b": {"op": "op_b",
+                                                      "ms": 2.0}}
+    monkeypatch.setattr(bench_ops, "run", lambda: ok)
+    bench_ops.main()  # no SystemExit

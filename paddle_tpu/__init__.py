@@ -89,6 +89,9 @@ from paddle_tpu import vision  # noqa: E402
 from paddle_tpu import metric  # noqa: E402
 from paddle_tpu import profiler  # noqa: E402
 from paddle_tpu import hapi  # noqa: E402
+from paddle_tpu import distribution  # noqa: E402
+from paddle_tpu import sparse  # noqa: E402
+from paddle_tpu import quantization  # noqa: E402
 from paddle_tpu.hapi import Model  # noqa: E402
 from paddle_tpu.hapi import callbacks  # noqa: E402
 

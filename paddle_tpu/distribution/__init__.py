@@ -1,0 +1,17 @@
+"""paddle.distribution analog (python/paddle/distribution/): probability
+distributions with sample/rsample/log_prob/entropy plus a kl_divergence
+registry.
+
+TPU-native: densities are jnp math dispatched through the op layer (so
+log_prob is differentiable on the tape and under jit), sampling draws
+from the framework PRNG (core.random), and reparameterized rsample keeps
+gradients flowing on TPU-compiled training steps.
+"""
+from .distributions import (Bernoulli, Beta, Categorical, Distribution,
+                            Exponential, Gumbel, Laplace, LogNormal,
+                            Multinomial, Normal, Uniform)
+from .kl import kl_divergence, register_kl
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Exponential", "Laplace", "Gumbel", "LogNormal",
+           "Multinomial", "kl_divergence", "register_kl"]

@@ -1,0 +1,344 @@
+"""Distribution classes — analogs of python/paddle/distribution/
+(distribution.py Distribution base, normal.py, uniform.py,
+categorical.py, bernoulli.py, beta.py, ...). Math is jnp through the op
+layer; samples come from the framework PRNG so paddle.seed governs them.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import random as random_mod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply, apply_nograd
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Exponential", "Laplace", "Gumbel", "LogNormal",
+           "Multinomial"]
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x._array.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+def _t(a):
+    return Tensor._wrap(a)
+
+
+def _shape(sample_shape, base_shape):
+    return tuple(sample_shape) + tuple(base_shape)
+
+
+class Distribution:
+    """Base (distribution.py:Distribution). Subclasses define
+    _batch_shape and the math; sample() draws via the framework PRNG.
+    Constructors keep the ORIGINAL parameter Tensors (_keep/_p) so
+    log_prob/rsample/kl_divergence gradients reach them."""
+
+    def __init__(self, batch_shape=()):
+        self._batch_shape = tuple(batch_shape)
+
+    def _keep(self, **named):
+        self._param_t = {k: v for k, v in named.items()
+                         if isinstance(v, Tensor)}
+
+    def _p(self, name):
+        t = getattr(self, "_param_t", {}).get(name)
+        return t if t is not None else _t(getattr(self, name))
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply("dist_prob", jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """normal.py:Normal — loc/scale gaussian."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+        self._keep(loc=loc, scale=scale)
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(random_mod.next_key(),
+                                _shape(shape, self.batch_shape))
+        return _t(self.loc + self.scale * eps)
+
+    def rsample(self, shape=()):
+        # reparameterized: gradient flows to loc/scale through the tape
+        eps = jax.random.normal(random_mod.next_key(),
+                                _shape(shape, self.batch_shape))
+        return apply("normal_rsample", lambda l, s: l + s * eps,
+                     self._p("loc"), self._p("scale"))
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            var = scale ** 2
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) \
+                - 0.5 * math.log(2 * math.pi)
+        v = value if isinstance(value, Tensor) else _t(_arr(value))
+        return apply("normal_log_prob", fn, v, self._p("loc"),
+                     self._p("scale"))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+
+    def sample(self, shape=()):
+        return apply("lognormal_sample", jnp.exp, self.base.sample(shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lp = self.base.log_prob(_t(jnp.log(v)))  # tape-tracked
+        return apply("lognormal_log_prob", lambda a: a - jnp.log(v), lp)
+
+    def entropy(self):
+        return apply("lognormal_entropy",
+                     lambda e, l: e + l,
+                     self.base.entropy(), self.base._p("loc"))
+
+
+class Uniform(Distribution):
+    """uniform.py:Uniform on [low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(random_mod.next_key(),
+                               _shape(shape, self.batch_shape))
+        return _t(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return _t(lp)
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                   self.batch_shape))
+
+
+class Categorical(Distribution):
+    """categorical.py:Categorical over the LAST axis of logits."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            p = _arr(probs)
+            self.logits = jnp.log(p / p.sum(-1, keepdims=True))
+        else:
+            lg = _arr(logits)
+            self.logits = lg - jax.nn.logsumexp(lg, -1, keepdims=True)
+        self._src_kind = "probs" if probs is not None else "logits"
+        super().__init__(self.logits.shape[:-1])
+        self._keep(_src=probs if probs is not None else logits)
+
+    def _norm_logits_fn(self):
+        """(src_array) -> normalized log-probs, in-graph (for tracked
+        gradient paths like kl_divergence)."""
+        if self._src_kind == "probs":
+            return lambda p: jnp.log(p / p.sum(-1, keepdims=True))
+        return lambda lg: lg - jax.nn.logsumexp(lg, -1, keepdims=True)
+
+    def _src(self):
+        t = getattr(self, "_param_t", {}).get("_src")
+        return t if t is not None else _t(self.logits) \
+            if self._src_kind == "logits" else _t(jnp.exp(self.logits))
+
+    @property
+    def probs(self):
+        return _t(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        return _t(jax.random.categorical(
+            random_mod.next_key(), self.logits,
+            shape=_shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        idx = _arr(value, jnp.int32)
+        return _t(jnp.take_along_axis(
+            self.logits, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        return _t(-(jnp.exp(self.logits) * self.logits).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+        super().__init__(self.probs_.shape)
+        self._keep(probs_=probs)
+
+    @property
+    def mean(self):
+        return _t(self.probs_)
+
+    @property
+    def variance(self):
+        return _t(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(random_mod.next_key(),
+                               _shape(shape, self.batch_shape))
+        return _t((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _t(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _t(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self.probs_ = p / p.sum(-1, keepdims=True)
+        super().__init__(self.probs_.shape[:-1])
+
+    def sample(self, shape=()):
+        logits = jnp.log(self.probs_)
+        draws = jax.random.categorical(
+            random_mod.next_key(), logits,
+            shape=(self.total_count,) + _shape(shape, self.batch_shape))
+        k = self.probs_.shape[-1]
+        return _t(jax.nn.one_hot(draws, k).sum(0))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = (v * jnp.log(self.probs_)).sum(-1)
+        coeff = jax.scipy.special.gammaln(self.total_count + 1.0) \
+            - jax.scipy.special.gammaln(v + 1.0).sum(-1)
+        return _t(coeff + logp)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        return _t(jax.random.beta(random_mod.next_key(), self.alpha,
+                                  self.beta,
+                                  _shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return _t((self.alpha - 1) * jnp.log(v)
+                  + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+        self._keep(rate=rate)
+
+    def sample(self, shape=()):
+        e = jax.random.exponential(random_mod.next_key(),
+                                   _shape(shape, self.batch_shape))
+        return _t(e / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate)
+                  + jnp.zeros(self.batch_shape, jnp.float32))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        l = jax.random.laplace(random_mod.next_key(),
+                               _shape(shape, self.batch_shape))
+        return _t(self.loc + self.scale * l)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(random_mod.next_key(),
+                              _shape(shape, self.batch_shape))
+        return _t(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))

@@ -1,0 +1,82 @@
+"""kl_divergence + register_kl — analog of
+python/paddle/distribution/kl.py (dispatch by distribution types)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+_REGISTRY = {}
+
+__all__ = ["kl_divergence", "register_kl"]
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+def _t(a):
+    return Tensor._wrap(a)
+
+
+from paddle_tpu.ops.dispatch import apply  # noqa: E402
+from .distributions import (Bernoulli, Categorical, Exponential,  # noqa
+                            Laplace, Normal, Uniform)
+
+# every rule dispatches through apply() on the distributions' KEPT
+# parameter Tensors (_p), so a KL regularizer (e.g. a VAE's) actually
+# trains the parameters instead of silently detaching
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        vr = (ps / qs) ** 2
+        return 0.5 * (vr + ((pl - ql) / qs) ** 2 - 1 - jnp.log(vr))
+    return apply("kl_normal_normal", fn, p._p("loc"), p._p("scale"),
+                 q._p("loc"), q._p("scale"))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return _t(jnp.where(inside, kl, jnp.inf))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pn, qn = p._norm_logits_fn(), q._norm_logits_fn()
+
+    def fn(ps, qs):
+        pl, ql = pn(ps), qn(qs)
+        return (jnp.exp(pl) * (pl - ql)).sum(-1)
+    return apply("kl_categorical", fn, p._src(), q._src())
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pa, qa):
+        a = jnp.clip(pa, 1e-7, 1 - 1e-7)
+        b = jnp.clip(qa, 1e-7, 1 - 1e-7)
+        return a * (jnp.log(a) - jnp.log(b)) \
+            + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b))
+    return apply("kl_bernoulli", fn, p._p("probs_"), q._p("probs_"))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def fn(pr, qr):
+        return jnp.log(pr) - jnp.log(qr) + qr / pr - 1.0
+    return apply("kl_exponential", fn, p._p("rate"), q._p("rate"))

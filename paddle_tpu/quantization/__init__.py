@@ -1,0 +1,229 @@
+"""paddle.quantization analog (python/paddle/quantization/): QuantConfig
++ QAT (fake-quant with straight-through gradients) + PTQ (observer
+calibration then convert).
+
+TPU-native: fake-quant runs as jnp round/clip inside the same compiled
+step as everything else (STE via PyLayer custom_vjp, which survives
+tracing); converted inference layers store int8 weights + scales and
+dequantize at the matmul edge, letting the MXU consume int8 where XLA
+chooses to.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.pylayer import PyLayer
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply
+
+__all__ = ["quantize_absmax", "dequantize", "fake_quant",
+           "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver",
+           "QuantConfig", "QAT", "PTQ", "QuantedLinear"]
+
+
+def quantize_absmax(w, bits=8, axis=None):
+    """Symmetric absmax quantization. Returns (int8 array, scale).
+    axis=None: per-tensor; axis=k: per-channel scales along k."""
+    arr = w._array if isinstance(w, Tensor) else jnp.asarray(w)
+    qmax = 2 ** (bits - 1) - 1
+    if axis is None:
+        scale = jnp.max(jnp.abs(arr)) / qmax
+    else:
+        red = tuple(i for i in range(arr.ndim) if i != axis)
+        scale = (jnp.max(jnp.abs(arr), axis=red, keepdims=True) / qmax)
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(arr / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+class _FakeQuantSTE(PyLayer):
+    """Round-to-grid forward, identity gradient (the reference's
+    fake_quantize_dequantize_abs_max op + its straight-through grad)."""
+
+    @staticmethod
+    def forward(ctx, x, scale, qmax):
+        arr = x._array
+        s = scale._array if isinstance(scale, Tensor) else scale
+        q = jnp.clip(jnp.round(arr / s), -qmax - 1, qmax)
+        return Tensor._wrap(q * s)
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy  # STE
+
+
+def fake_quant(x, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    return _FakeQuantSTE.apply(x, scale, qmax)
+
+
+class AbsmaxObserver(nn.Layer):
+    """PTQ observer (observers/abs_max.py): tracks max |x| seen."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._absmax = 0.0
+
+    def forward(self, x):
+        self._absmax = max(self._absmax,
+                           float(jnp.max(jnp.abs(x._array))))
+        return x
+
+    def scale(self):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return max(self._absmax, 1e-8) / qmax
+
+
+class FakeQuanterWithAbsMaxObserver(nn.Layer):
+    """QAT quanter (quanters/abs_max.py): moving-average absmax + STE
+    fake-quant; the observed scale updates eagerly between steps."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self._absmax = None
+
+    def forward(self, x):
+        import jax
+
+        if not isinstance(x._array, jax.core.Tracer):
+            # observation is an eager-side effect; inside a compiled step
+            # the last observed scale is baked into the trace
+            cur = float(jnp.max(jnp.abs(x._array)))
+            self._absmax = cur if self._absmax is None else \
+                self.moving_rate * self._absmax + \
+                (1 - self.moving_rate) * cur
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        scale = max(self._absmax or 1.0, 1e-8) / qmax
+        return fake_quant(x, jnp.float32(scale), self.quant_bits)
+
+
+class QuantConfig:
+    """config.py:QuantConfig lite: one activation + one weight quanter
+    factory applied to every quantizable layer."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+
+    def _make(self, which):
+        proto = self.activation if which == "a" else self.weight
+        if proto is None:
+            return None
+        # factories are "quanter prototypes": instantiate per layer
+        if isinstance(proto, type):
+            return proto()
+        return type(proto)(**{k: v for k, v in vars(proto).items()
+                              if k in ("moving_rate", "quant_bits")})
+
+
+class QATLinear(nn.Layer):
+    """Training-time quantized Linear: fake-quant weight + activation."""
+
+    def __init__(self, inner, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.a_quanter = a_quanter
+        self.w_quanter = w_quanter
+
+    def forward(self, x):
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.inner.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(w)
+        out = x.matmul(w)
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QuantedLinear(nn.Layer):
+    """Inference-time converted Linear: int8 weight + scale, dequant at
+    the matmul edge."""
+
+    def __init__(self, linear, act_scale=None):
+        super().__init__()
+        self.qweight, self.wscale = quantize_absmax(linear.weight, axis=1)
+        self.bias = linear.bias
+        self.act_scale = act_scale
+        self.weight_shape = list(linear.weight.shape)
+
+    def forward(self, x):
+        if self.act_scale is not None:
+            # PTQ-calibrated activation quantization (round to the
+            # observed int8 grid before the matmul)
+            qmax = 127
+            s = self.act_scale
+
+            def aq(a):
+                return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
+            x = apply("quant_act", aq, x)
+        w = dequantize(self.qweight, self.wscale)
+        out = x.matmul(Tensor._wrap(w))
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def _replace_layers(model, predicate, factory):
+    for name, child in list(model._sub_layers.items()):
+        if predicate(child):
+            setattr(model, name, factory(child))
+        else:
+            _replace_layers(child, predicate, factory)
+    return model
+
+
+class QAT:
+    """qat.py:QAT — wrap quantizable layers with fake-quanters."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=True):
+        cfg = self.config
+        return _replace_layers(
+            model, lambda l: isinstance(l, nn.Linear),
+            lambda l: QATLinear(l, cfg._make("a"), cfg._make("w")))
+
+
+class PTQ:
+    """ptq.py:PTQ — observe activations, then convert to quantized
+    inference layers."""
+
+    class _Observed(nn.Layer):
+        def __init__(self, inner, observer):
+            super().__init__()
+            self.inner = inner
+            self.observer = observer
+
+        def forward(self, x):
+            if self.observer is not None:
+                x = self.observer(x)
+            return self.inner(x)
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig(activation=AbsmaxObserver,
+                                            weight=None)
+
+    def quantize(self, model, inplace=True):
+        cfg = self.config
+        return _replace_layers(
+            model, lambda l: isinstance(l, nn.Linear),
+            lambda l: PTQ._Observed(l, cfg._make("a")))
+
+    def convert(self, model, inplace=True):
+        return _replace_layers(
+            model, lambda l: isinstance(l, PTQ._Observed),
+            lambda l: QuantedLinear(
+                l.inner,
+                act_scale=l.observer.scale() if l.observer else None))

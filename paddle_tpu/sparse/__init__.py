@@ -1,0 +1,317 @@
+"""paddle.sparse analog (python/paddle/sparse/): SparseCooTensor /
+SparseCsrTensor with creation, conversion and compute ops.
+
+TPU-native design: XLA has no native sparse storage, and the reference's
+cuSPARSE kernels have no TPU counterpart — but sparse compute maps well
+onto gather + segment_sum, which XLA lowers to efficient TPU scatter
+ops. Values live in a dense [nnz, ...] Tensor, so every op dispatches
+through the normal op layer and is differentiable w.r.t. values and any
+dense operand (tape + jit alike). Static-shape discipline: nnz is fixed
+per tensor (compile-once under jit), matching XLA's static-shape model.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "matmul", "masked_matmul", "add", "relu",
+           "tanh", "sqrt", "sin", "transpose", "is_same_shape"]
+
+
+def _arr(x, dtype=None):
+    a = x._array if isinstance(x, Tensor) else jnp.asarray(x)
+    return a.astype(dtype) if dtype is not None else a
+
+
+class SparseCooTensor:
+    """COO: indices [sparse_ndim, nnz] int32, values Tensor [nnz, ...]."""
+
+    def __init__(self, indices, values, shape, coalesced=False):
+        self._indices = _arr(indices, jnp.int32)
+        self._values = values if isinstance(values, Tensor) \
+            else Tensor(values)
+        self.shape = list(shape)
+        self._coalesced = coalesced
+
+    # paddle parity surface
+    def indices(self):
+        return Tensor._wrap(self._indices)
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def to_dense(self):
+        shape = tuple(self.shape)
+        sp_nd = self._indices.shape[0]
+        idx = tuple(self._indices[d] for d in range(sp_nd))
+
+        def fn(vals):
+            dense = jnp.zeros(shape, vals.dtype)
+            return dense.at[idx].add(vals)
+        return apply("sparse_to_dense", fn, self._values)
+
+    def to_sparse_csr(self):
+        if len(self.shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        coo = self.coalesce()
+        rows, cols = coo._indices[0], coo._indices[1]
+        crows = jnp.cumsum(jnp.bincount(rows, length=self.shape[0]))
+        crows = jnp.concatenate([jnp.zeros((1,), crows.dtype), crows])
+        return SparseCsrTensor(crows, cols, coo._values, self.shape)
+
+    def coalesce(self):
+        """Sort + merge duplicate coordinates (host-side index prep; the
+        values merge is a tracked segment_sum)."""
+        if self._coalesced:
+            return self
+        sp_nd = self._indices.shape[0]
+        flat = np.ravel_multi_index(
+            tuple(np.asarray(self._indices)), tuple(self.shape[:sp_nd]))
+        uniq, inv = np.unique(flat, return_inverse=True)
+        new_idx = jnp.asarray(
+            np.stack(np.unravel_index(uniq, tuple(self.shape[:sp_nd]))),
+            jnp.int32)
+        seg = jnp.asarray(inv, jnp.int32)
+        n = len(uniq)
+
+        def fn(vals):
+            import jax
+
+            return jax.ops.segment_sum(vals, seg, num_segments=n)
+        return SparseCooTensor(new_idx, apply("sparse_coalesce", fn,
+                                              self._values),
+                               self.shape, coalesced=True)
+
+    def transpose(self, perm):
+        if sorted(perm) != list(range(len(self.shape))):
+            raise ValueError(f"bad perm {perm}")
+        sp_nd = self._indices.shape[0]
+        if sp_nd != len(self.shape):
+            raise NotImplementedError(
+                "transpose of a hybrid COO tensor (dense trailing dims) "
+                "is not supported; densify first")
+        new_idx = self._indices[jnp.asarray(perm, jnp.int32)]
+        return SparseCooTensor(new_idx, self._values,
+                               [self.shape[p] for p in perm])
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR: crows [M+1], cols [nnz], values Tensor [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _arr(crows, jnp.int32)
+        self._cols = _arr(cols, jnp.int32)
+        self._values = values if isinstance(values, Tensor) \
+            else Tensor(values)
+        self.shape = list(shape)
+
+    def crows(self):
+        return Tensor._wrap(self._crows)
+
+    def cols(self):
+        return Tensor._wrap(self._cols)
+
+    def values(self):
+        return self._values
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    def _rows(self):
+        counts = jnp.diff(self._crows)
+        return jnp.repeat(jnp.arange(len(counts), dtype=jnp.int32), counts,
+                          total_repeat_length=self.nnz())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        idx = jnp.stack([self._rows(), self._cols])
+        return SparseCooTensor(idx, self._values, self.shape,
+                               coalesced=True)
+
+    def to_dense(self):
+        return self.to_sparse_coo().to_dense()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+# -- creation ---------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = _arr(indices, jnp.int32)
+    vals = values if isinstance(values, Tensor) else Tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        if idx.shape[1] == 0:
+            raise ValueError(
+                "shape is required for an empty (nnz=0) sparse tensor")
+        shape = [int(d) + 1 for d in np.asarray(idx).max(axis=1)]
+    t = SparseCooTensor(idx, vals, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = values if isinstance(values, Tensor) else Tensor(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    t = SparseCsrTensor(crows, cols, vals, shape)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _coo(x, op):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"sparse.{op} expects a sparse tensor, "
+                        f"got {type(x).__name__}")
+    return x
+
+
+# -- compute ----------------------------------------------------------------
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (sparse.matmul). COO/CSR [M,K] @ [K,N]:
+    gather rows of y at col indices, scale by values, segment_sum into M
+    rows — the TPU-efficient SpMM lowering."""
+    import jax
+
+    sp = _coo(x, "matmul")
+    if len(sp.shape) != 2:
+        raise ValueError("sparse.matmul supports 2-D sparse operands")
+    rows, cols = sp._indices[0], sp._indices[1]
+    M = sp.shape[0]
+    dense = y if isinstance(y, Tensor) else Tensor(y)
+
+    def fn(vals, d):
+        contrib = vals[:, None] * d[cols]
+        return jax.ops.segment_sum(contrib, rows, num_segments=M)
+    return apply("sparse_matmul", fn, sp._values, dense)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated ONLY at mask's nnz positions
+    (sparse.masked_matmul): per-nonzero dot products — no dense [M,N]
+    product is ever materialized."""
+    sp = _coo(mask, "masked_matmul")
+    rows, cols = sp._indices[0], sp._indices[1]
+    a = x if isinstance(x, Tensor) else Tensor(x)
+    b = y if isinstance(y, Tensor) else Tensor(y)
+
+    def fn(aa, bb):
+        return (aa[rows] * bb.T[cols]).sum(-1)
+    vals = apply("sparse_masked_matmul", fn, a, b)
+    return SparseCooTensor(sp._indices, vals, sp.shape,
+                           coalesced=sp._coalesced)
+
+
+def add(x, y, name=None):
+    """sparse + sparse (same sparsity pattern fast path; else union via
+    concatenation + coalesce)."""
+    a, b = _coo(x, "add"), _coo(y, "add")
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    if a._indices.shape == b._indices.shape and \
+            bool(jnp.all(a._indices == b._indices)):
+        vals = apply("sparse_add", lambda u, v: u + v,
+                     a._values, b._values)
+        return SparseCooTensor(a._indices, vals, a.shape, a._coalesced)
+    idx = jnp.concatenate([a._indices, b._indices], axis=1)
+    vals = apply("sparse_add_cat",
+                 lambda u, v: jnp.concatenate([u, v]),
+                 a._values, b._values)
+    return SparseCooTensor(idx, vals, a.shape).coalesce()
+
+
+def _unary(name, fn):
+    def op(x, name_=None):
+        sp = _coo(x, name)
+        vals = apply(f"sparse_{name}", fn, sp._values)
+        out = SparseCooTensor(sp._indices, vals, sp.shape, sp._coalesced)
+        return out
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+sin = _unary("sin", jnp.sin)
+
+
+def transpose(x, perm, name=None):
+    return _coo(x, "transpose").transpose(perm)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _dense_to_sparse_coo(self, sparse_dim):
+    """Tensor.to_sparse_coo (dense→sparse is data-dependent, so this is
+    an eager-only conversion — index discovery happens on host)."""
+    a = np.asarray(self._array)
+    if sparse_dim != a.ndim:
+        raise NotImplementedError(
+            "only sparse_dim == ndim (fully sparse) is supported")
+    nz = np.nonzero(a)
+    idx = jnp.asarray(np.stack(nz), jnp.int32)
+    vals = Tensor._wrap(self._array[tuple(jnp.asarray(n) for n in nz)])
+    return SparseCooTensor(idx, vals, list(a.shape), coalesced=True)
+
+
+def _dense_to_sparse_csr(self):
+    return _dense_to_sparse_coo(self, 2).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _dense_to_sparse_coo
+Tensor.to_sparse_csr = _dense_to_sparse_csr

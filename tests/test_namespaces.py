@@ -1,0 +1,236 @@
+"""distribution / sparse / quantization namespace tests (SURVEY item 38,
+VERDICT r2 missing #8).
+
+Reference analogs: python/paddle/distribution/, python/paddle/sparse/,
+python/paddle/quantization/.
+"""
+import numpy as np
+import pytest
+from scipy import stats as spstats
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distribution import (Bernoulli, Categorical, Normal,
+                                     Uniform, kl_divergence)
+
+
+# -- distribution -------------------------------------------------------
+def test_normal_log_prob_and_entropy():
+    d = Normal(loc=1.0, scale=2.0)
+    for v in (-1.0, 0.0, 3.5):
+        np.testing.assert_allclose(float(d.log_prob(v)._array),
+                                   spstats.norm.logpdf(v, 1.0, 2.0),
+                                   rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy()._array),
+                               spstats.norm.entropy(1.0, 2.0), rtol=1e-5)
+
+
+def test_normal_sample_statistics():
+    paddle.seed(0)
+    d = Normal(loc=np.array([0.0, 5.0], np.float32), scale=1.0)
+    s = np.asarray(d.sample((4000,))._array)
+    assert s.shape == (4000, 2)
+    np.testing.assert_allclose(s.mean(0), [0.0, 5.0], atol=0.1)
+    np.testing.assert_allclose(s.std(0), [1.0, 1.0], atol=0.1)
+
+
+def test_normal_rsample_grad_flows():
+    paddle.seed(0)
+    loc = paddle.to_tensor(np.array([0.5], np.float32))
+    loc.stop_gradient = False
+    d = Normal(loc=loc, scale=1.0)
+    s = d.rsample((64,))
+    s.mean().backward()
+    assert loc.grad is not None
+    np.testing.assert_allclose(float(loc.grad._array[0]), 1.0, rtol=1e-4)
+
+
+def test_categorical_and_kl():
+    logits = np.log(np.array([[0.2, 0.3, 0.5]], np.float32))
+    d = Categorical(logits=logits)
+    np.testing.assert_allclose(float(d.log_prob(np.array([2]))._array[0]),
+                               np.log(0.5), rtol=1e-5)
+    q = Categorical(probs=np.array([[1 / 3] * 3], np.float32))
+    kl = float(kl_divergence(d, q)._array[0])
+    want = (np.array([0.2, 0.3, 0.5]) *
+            np.log(np.array([0.2, 0.3, 0.5]) / (1 / 3))).sum()
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+
+def test_kl_normal_normal_closed_form():
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    got = float(kl_divergence(p, q)._array)
+    vr = 0.25
+    want = 0.5 * (vr + 0.25 - 1 - np.log(vr))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    with pytest.raises(NotImplementedError, match="no KL"):
+        kl_divergence(p, Bernoulli(probs=0.5))
+
+
+def test_kl_gradient_reaches_parameters():
+    """VAE-style: KL(Normal(mu,1) || Normal(0,1)) must train mu."""
+    mu = paddle.to_tensor(np.array([2.0], np.float32))
+    mu.stop_gradient = False
+    kl = kl_divergence(Normal(mu, 1.0), Normal(0.0, 1.0))
+    kl.sum().backward()
+    assert mu.grad is not None
+    # d/dmu [0.5*mu^2] = mu
+    np.testing.assert_allclose(np.asarray(mu.grad._array), [2.0],
+                               rtol=1e-5)
+
+
+def test_uniform_bernoulli():
+    u = Uniform(low=2.0, high=4.0)
+    assert float(u.log_prob(3.0)._array) == pytest.approx(np.log(0.5))
+    assert float(u.log_prob(5.0)._array) == -np.inf
+    b = Bernoulli(probs=0.25)
+    np.testing.assert_allclose(float(b.log_prob(1.0)._array), np.log(0.25),
+                               rtol=1e-5)
+    paddle.seed(1)
+    s = np.asarray(b.sample((5000,))._array)
+    assert abs(s.mean() - 0.25) < 0.03
+
+
+# -- sparse -------------------------------------------------------------
+def _coo_fixture():
+    dense = np.zeros((3, 4), np.float32)
+    dense[0, 1] = 1.0
+    dense[1, 3] = 2.0
+    dense[2, 0] = -3.0
+    idx = np.array([[0, 1, 2], [1, 3, 0]])
+    vals = np.array([1.0, 2.0, -3.0], np.float32)
+    return dense, idx, vals
+
+
+def test_sparse_coo_roundtrip():
+    dense, idx, vals = _coo_fixture()
+    sp = paddle.sparse.sparse_coo_tensor(idx, vals, [3, 4])
+    assert sp.nnz() == 3 and sp.is_sparse_coo()
+    np.testing.assert_array_equal(np.asarray(sp.to_dense()._array), dense)
+    # dense -> coo -> csr -> dense
+    t = paddle.to_tensor(dense)
+    coo = t.to_sparse_coo(2)
+    assert coo.nnz() == 3
+    csr = coo.to_sparse_csr()
+    np.testing.assert_array_equal(np.asarray(csr.crows()._array),
+                                  [0, 1, 2, 3])
+    np.testing.assert_array_equal(np.asarray(csr.to_dense()._array), dense)
+
+
+def test_sparse_matmul_matches_dense_and_backprops():
+    dense, idx, vals = _coo_fixture()
+    sp = paddle.sparse.sparse_coo_tensor(idx, vals, [3, 4])
+    y = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    y.stop_gradient = False
+    out = paddle.sparse.matmul(sp, y)
+    np.testing.assert_allclose(np.asarray(out._array), dense @
+                               np.asarray(y._array), rtol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(y.grad._array),
+                               dense.T @ np.ones((3, 2), np.float32),
+                               rtol=1e-6)
+
+
+def test_sparse_add_and_unary():
+    dense, idx, vals = _coo_fixture()
+    a = paddle.sparse.sparse_coo_tensor(idx, vals, [3, 4])
+    b = paddle.sparse.sparse_coo_tensor(idx, vals, [3, 4])
+    s = paddle.sparse.add(a, b)
+    np.testing.assert_array_equal(np.asarray(s.to_dense()._array),
+                                  2 * dense)
+    r = paddle.sparse.relu(a)
+    np.testing.assert_array_equal(np.asarray(r.to_dense()._array),
+                                  np.maximum(dense, 0))
+    # different patterns: union + coalesce
+    idx2 = np.array([[0, 2], [1, 0]])
+    c = paddle.sparse.sparse_coo_tensor(idx2,
+                                        np.array([10.0, 5.0], np.float32),
+                                        [3, 4])
+    u = paddle.sparse.add(a, c)
+    want = dense.copy()
+    want[0, 1] += 10.0
+    want[2, 0] += 5.0
+    np.testing.assert_array_equal(np.asarray(u.to_dense()._array), want)
+
+
+def test_sparse_masked_matmul():
+    rs = np.random.RandomState(0)
+    A = rs.randn(3, 5).astype(np.float32)
+    B = rs.randn(5, 4).astype(np.float32)
+    _, idx, _ = _coo_fixture()
+    mask = paddle.sparse.sparse_coo_tensor(
+        idx, np.ones(3, np.float32), [3, 4])
+    out = paddle.sparse.masked_matmul(paddle.to_tensor(A),
+                                      paddle.to_tensor(B), mask)
+    full = A @ B
+    got = np.asarray(out.values()._array)
+    for k, (i, j) in enumerate(zip(idx[0], idx[1])):
+        np.testing.assert_allclose(got[k], full[i, j], rtol=1e-5)
+
+
+# -- quantization -------------------------------------------------------
+def test_quantize_absmax_roundtrip():
+    from paddle_tpu.quantization import dequantize, quantize_absmax
+
+    w = paddle.to_tensor(np.linspace(-2, 2, 32).astype(np.float32))
+    q, scale = quantize_absmax(w)
+    assert str(q.dtype) == "int8"
+    np.testing.assert_allclose(np.asarray(dequantize(q, scale)),
+                               np.asarray(w._array), atol=2 / 127 + 1e-6)
+
+
+def test_fake_quant_ste_gradient():
+    from paddle_tpu.quantization import fake_quant
+
+    x = paddle.to_tensor(np.array([0.11, -0.49, 0.3], np.float32))
+    x.stop_gradient = False
+    y = fake_quant(x, np.float32(0.1))
+    np.testing.assert_allclose(np.asarray(y._array), [0.1, -0.5, 0.3],
+                               atol=1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._array), [1, 1, 1])
+
+
+def test_qat_trains_and_ptq_converts():
+    from paddle_tpu.quantization import (PTQ, QAT, QuantConfig,
+                                         FakeQuanterWithAbsMaxObserver,
+                                         QuantedLinear)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(16, 8).astype(np.float32))
+    ref = np.asarray(net(x)._array)
+
+    # QAT: fake-quant wrappers train
+    qat_net = QAT(QuantConfig(
+        activation=FakeQuanterWithAbsMaxObserver,
+        weight=FakeQuanterWithAbsMaxObserver)).quantize(net)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=qat_net.parameters())
+    tgt = paddle.to_tensor(np.zeros((16, 4), np.float32))
+    losses = []
+    for _ in range(5):
+        loss = F.mse_loss(qat_net(x), tgt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    # PTQ: observe then convert to int8-weight layers
+    paddle.seed(0)
+    net2 = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    ptq = PTQ()
+    net2 = ptq.quantize(net2)
+    for _ in range(3):
+        net2(x)  # calibration passes
+    net2 = ptq.convert(net2)
+    assert isinstance(net2[0], QuantedLinear)
+    out = np.asarray(net2(x)._array)
+    # int8 weights: close to the fp32 reference (same seed)
+    paddle.seed(0)
+    net3 = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    ref3 = np.asarray(net3(x)._array)
+    assert np.abs(out - ref3).max() < 0.1

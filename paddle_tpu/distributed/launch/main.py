@@ -47,31 +47,26 @@ def build_parser():
                    help="virtual device count per rank (cpu backend only)")
     p.add_argument("--log-dir", default=None,
                    help="write per-rank logs to files instead of stdout")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="elastic fault tolerance: relaunch the whole pod "
+                        "up to N times after a rank failure (the "
+                        "ElasticManager watch/restart analog, "
+                        "fleet/elastic/manager.py)")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
 
 
 def _rank_env(args, rank: int, master: str) -> dict:
+    from paddle_tpu.distributed.spawn import rank_env_overrides
+
     env = dict(os.environ)
-    env["PADDLE_TRAINER_ID"] = str(rank)
-    env["PADDLE_TRAINERS_NUM"] = str(args.nprocs)
-    env["PADDLE_MASTER"] = master
-    env["MASTER_ADDR"], env["MASTER_PORT"] = master.split(":")
-    if args.backend == "cpu":
-        env["JAX_PLATFORMS"] = "cpu"
-        # a TPU-plugin sitecustomize (if present on PYTHONPATH) must not
-        # grab the backend before jax.distributed.initialize runs in the
-        # rank; plugin registration is keyed off its pool env var
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        flags = env.get("XLA_FLAGS", "")
-        # strip any inherited device-count flag before setting ours
-        flags = " ".join(f for f in flags.split()
-                         if not f.startswith("--xla_force_host_platform_device_count"))
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count="
-                            + str(args.devices_per_proc)).strip()
-    elif args.backend == "tpu":
-        env["JAX_PLATFORMS"] = "tpu"
+    for k, v in rank_env_overrides(rank, args.nprocs, master, args.backend,
+                                   args.devices_per_proc).items():
+        if v is None:
+            env.pop(k, None)
+        else:
+            env[k] = v
     return env
 
 
@@ -84,19 +79,30 @@ def _stream(proc, rank):
 def launch(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.master:
-        return _launch_once(args, args.master, None)
-    # hold the probe socket (SO_REUSEADDR) until the ranks are spawned so
-    # another process can't grab the auto-picked coordinator port in the
-    # selection->bind window; rank 0's coordination service binds with
-    # reuse and takes over
-    probe = socket.socket()
-    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    probe.bind(("127.0.0.1", 0))
-    port = probe.getsockname()[1]
-    return _launch_once(args, f"127.0.0.1:{port}", probe)
+        master, probe = args.master, None
+    else:
+        # hold the probe socket (SO_REUSEADDR) until the ranks are
+        # spawned so another process can't grab the auto-picked
+        # coordinator port in the selection->bind window; rank 0's
+        # coordination service binds with reuse and takes over
+        probe = socket.socket()
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        probe.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{probe.getsockname()[1]}"
+    rc = _launch_once(args, master, probe)
+    # elastic restart loop (ElasticManager.watch -> restart analog):
+    # a failed pod is torn down and relaunched whole, same endpoints
+    restarts = 0
+    while rc != 0 and restarts < args.max_restarts:
+        restarts += 1
+        sys.stderr.write(
+            f"[launch] pod failed (rc={rc}); restart "
+            f"{restarts}/{args.max_restarts}\n")
+        rc = _launch_once(args, master, None, attempt=restarts)
+    return rc
 
 
-def _launch_once(args, master: str, probe) -> int:
+def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
     procs = []
     streams = []
     logs = []
@@ -115,7 +121,11 @@ def _launch_once(args, master: str, probe) -> int:
                 probe = None
             if args.log_dir:
                 os.makedirs(args.log_dir, exist_ok=True)
-                logf = open(os.path.join(args.log_dir, f"rank{rank}.log"), "w")
+                # attempt-suffixed on elastic restarts: the failed
+                # attempt's logs are the crash evidence — keep them
+                suffix = "" if attempt == 0 else f".restart{attempt}"
+                logf = open(os.path.join(
+                    args.log_dir, f"rank{rank}{suffix}.log"), "w")
                 logs.append(logf)
                 proc = subprocess.Popen(
                     [sys.executable, args.script] + args.script_args,

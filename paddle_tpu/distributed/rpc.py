@@ -1,0 +1,205 @@
+"""paddle.distributed.rpc — analog of python/paddle/distributed/rpc/
+rpc.py (init_rpc, rpc_sync, rpc_async, shutdown, get_worker_info over a
+brpc transport with a master-based WorkerInfo rendezvous).
+
+TPU-native lite: plain TCP + pickle between trusted cluster hosts (the
+same trust model as the reference's brpc). Each worker runs a daemon
+server thread executing incoming (func, args, kwargs); the master
+(rank 0) collects name->endpoint registrations and broadcasts the full
+WorkerInfo table. rpc_async returns a concurrent.futures.Future.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = {}
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack(">Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    head = b""
+    while len(head) < 8:
+        chunk = sock.recv(8 - len(head))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        head += chunk
+    n = struct.unpack(">Q", head)[0]
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            kind, payload = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        if kind == "call":
+            func, args, kwargs = payload
+            try:
+                _send_msg(self.request, ("ok", func(*args, **kwargs)))
+            except Exception as e:  # ship the failure back to the caller
+                _send_msg(self.request, ("err", e))
+        elif kind == "register":  # master only
+            with _state["reg_lock"]:
+                _state["registry"][payload.rank] = payload
+                if len(_state["registry"]) == _state["world_size"]:
+                    _state["reg_done"].set()
+            if not _state["reg_done"].wait(timeout=300):
+                _send_msg(self.request, ("err", TimeoutError(
+                    f"rpc rendezvous: only {len(_state['registry'])}/"
+                    f"{_state['world_size']} workers registered "
+                    "within 300s")))
+                return
+            _send_msg(self.request,
+                      ("ok", sorted(_state["registry"].values(),
+                                    key=lambda w: w.rank)))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start the local RPC server and rendezvous the WorkerInfo table
+    through the master (rank 0 doubles as the master, like the
+    reference's master_endpoint contract)."""
+    import os
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    if master_endpoint is None:
+        # default: collective master's port + 1 (the PADDLE_MASTER port
+        # itself is owned by jax's coordination service)
+        ip, port = os.environ.get("PADDLE_MASTER",
+                                  "127.0.0.1:29339").split(":")
+        master_endpoint = f"{ip}:{int(port) + 1}"
+
+    _state.clear()
+    _state.update(world_size=world_size, rank=rank, name=name,
+                  registry={}, reg_lock=threading.Lock(),
+                  reg_done=threading.Event(),
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+    m_ip, m_port = master_endpoint.split(":")
+    if rank == 0:
+        try:
+            # master serves on the well-known endpoint
+            srv = _Server((m_ip, int(m_port)), _Handler)
+        except OSError as e:
+            raise OSError(
+                f"rpc master endpoint {master_endpoint} is unavailable "
+                f"({e}); the default is the collective coordinator port "
+                "+ 1 — pass master_endpoint to init_rpc to choose "
+                "another") from e
+        port = srv.server_address[1]
+    else:
+        srv = _Server(("0.0.0.0", 0), _Handler)
+        port = srv.server_address[1]
+    _state["server"] = srv
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    if rank == 0:
+        me = WorkerInfo(name, rank, m_ip, port)
+        with _state["reg_lock"]:
+            _state["registry"][0] = me
+            if len(_state["registry"]) == world_size:
+                _state["reg_done"].set()
+        if not _state["reg_done"].wait(timeout=300):
+            raise TimeoutError(
+                f"rpc rendezvous: only {len(_state['registry'])}/"
+                f"{world_size} workers registered within 300s")
+        workers = sorted(_state["registry"].values(), key=lambda w: w.rank)
+    else:
+        # register with the master; retry while it comes up. The
+        # advertised ip is THIS host's address on the route to the
+        # master (multi-host peers must be able to dial it back).
+        for attempt in range(120):
+            try:
+                with socket.create_connection((m_ip, int(m_port)),
+                                              timeout=310) as s:
+                    my_ip = s.getsockname()[0]
+                    me = WorkerInfo(name, rank, my_ip, port)
+                    _send_msg(s, ("register", me))
+                    status, payload = _recv_msg(s)
+                if status == "err":
+                    raise payload
+                workers = payload
+                break
+            except ConnectionError:
+                time.sleep(0.25)
+            except OSError:
+                time.sleep(0.25)
+        else:
+            raise TimeoutError(f"rpc master {master_endpoint} unreachable")
+    _state["workers"] = {w.name: w for w in workers}
+    return me
+
+
+def get_worker_info(name=None):
+    ws = _state["workers"]
+    if name is None:
+        return ws[_state["name"]]
+    return ws[name]
+
+
+def get_all_worker_infos():
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def _call(to, fn, args, kwargs):
+    w = get_worker_info(to)
+    with socket.create_connection((w.ip, w.port), timeout=120) as s:
+        _send_msg(s, ("call", (fn, args, kwargs)))
+        status, payload = _recv_msg(s)
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=None):
+    """Run fn(*args, **kwargs) ON worker `to`, return its result."""
+    return _call(to, fn, tuple(args), kwargs or {})
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=None):
+    """Like rpc_sync but returns a Future (reference returns a
+    FutureWrapper with .wait())."""
+    fut = _state["pool"].submit(_call, to, fn, tuple(args), kwargs or {})
+    fut.wait = fut.result  # paddle parity: fut.wait()
+    return fut
+
+
+def shutdown():
+    srv = _state.get("server")
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    pool = _state.get("pool")
+    if pool is not None:
+        pool.shutdown(wait=False)
+    _state.clear()

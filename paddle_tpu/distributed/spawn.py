@@ -1,0 +1,123 @@
+"""paddle.distributed.spawn — analog of python/paddle/distributed/
+spawn.py: launch `func` in nprocs fresh processes with the collective
+env contract set, so `init_parallel_env()` inside func just works.
+
+Uses the multiprocessing 'spawn' start method (fresh interpreters — a
+forked jax runtime is unusable), a held probe socket for the coordinator
+port (same race-avoidance as the launcher CLI), and re-raises the first
+failing rank's traceback in the parent (the reference's
+MultiprocessContext.join error surfacing)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+import traceback
+
+__all__ = ["spawn"]
+
+
+def rank_env_overrides(rank, nprocs, master, backend=None,
+                       devices_per_proc=1):
+    """The collective env contract for one rank, as an overrides dict
+    (value None = unset). SHARED by dist.spawn and the launcher CLI —
+    the single definition of PADDLE_*/MASTER_*/backend env."""
+    env = {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": master,
+    }
+    env["MASTER_ADDR"], env["MASTER_PORT"] = master.split(":")
+    if backend == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        # a TPU-plugin sitecustomize (if present) must not grab the
+        # backend before jax.distributed.initialize runs in the rank
+        env["PALLAS_AXON_POOL_IPS"] = None
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if not f.startswith("--xla_force_host_platform_device_count"))
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            + str(devices_per_proc)).strip()
+    elif backend == "tpu":
+        env["JAX_PLATFORMS"] = "tpu"
+    return env
+
+
+def _worker(func, args, err_q, rank):
+    try:
+        func(*args)
+    except Exception:
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, backend=None,
+          devices_per_proc=1, **options):
+    """paddle.distributed.spawn parity. func runs in each rank's process
+    with PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/MASTER_* set."""
+    ctx = mp.get_context("spawn")
+    err_q = ctx.Queue()
+
+    probe = socket.socket()
+    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    probe.bind(("127.0.0.1", 0))
+    master = f"127.0.0.1:{probe.getsockname()[1]}"
+
+    procs = []
+    for rank in range(nprocs):
+        if rank == 0:
+            probe.close()  # release just before rank 0 can bind it
+        # the rank env must be live in the PARENT at start(): the spawn
+        # child inherits it at exec, BEFORE any sitecustomize (e.g. a
+        # TPU plugin's) imports jax — in-child os.environ writes would
+        # come too late to steer backend selection
+        overrides = rank_env_overrides(rank, nprocs, master, backend,
+                                       devices_per_proc)
+        saved = {k: os.environ.get(k) for k in overrides}
+        try:
+            for k, v in overrides.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            p = ctx.Process(target=_worker,
+                            args=(func, tuple(args), err_q, rank),
+                            daemon=daemon)
+            p.start()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        procs.append(p)
+
+    if not join:
+        return procs
+    # poll-based watch (launcher watch-loop semantics): first failure
+    # terminates the surviving ranks instead of blocking on their join
+    import time
+
+    rc = 0
+    pending = set(range(nprocs))
+    while pending:
+        for i in list(pending):
+            code = procs[i].exitcode
+            if code is not None:
+                pending.discard(i)
+                if code != 0 and rc == 0:
+                    rc = code
+                    for j in pending:
+                        if procs[j].is_alive():
+                            procs[j].terminate()
+        if pending:
+            time.sleep(0.1)
+    if rc:
+        detail = ""
+        if not err_q.empty():
+            rank, tb = err_q.get()
+            detail = f"\n--- rank {rank} traceback ---\n{tb}"
+        raise RuntimeError(f"spawn: a rank exited with code {rc}{detail}")
+    return procs

@@ -124,6 +124,39 @@ def run_ps(dist, paddle, rank, world):
     print("ok ps", flush=True)
 
 
+def _remote_square(x):
+    return x * x
+
+
+def _remote_matsum(n):
+    import paddle_tpu as paddle
+
+    return float(paddle.ones([n, n]).sum()._array)
+
+
+def run_rpc(dist, paddle, rank, world):
+    """RPC rendezvous + sync/async calls between the two ranks."""
+    from paddle_tpu.distributed import rpc
+
+    me = rpc.init_rpc(f"worker{rank}")
+    assert me.rank == rank
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == [f"worker{i}" for i in range(world)]
+    peer = f"worker{(rank + 1) % world}"
+    assert rpc.rpc_sync(peer, _remote_square, args=(7,)) == 49
+    fut = rpc.rpc_async(peer, _remote_matsum, args=(8,))
+    assert fut.wait() == 64.0
+    # exceptions propagate across the wire
+    try:
+        rpc.rpc_sync(peer, _remote_square, args=("x",))
+        raise AssertionError("expected remote TypeError")
+    except TypeError:
+        pass
+    dist.barrier()  # both sides done calling before servers go away
+    rpc.shutdown()
+    print("ok rpc", flush=True)
+
+
 def main():
     phase = sys.argv[1] if len(sys.argv) > 1 else "all"
     out_file = sys.argv[2] if len(sys.argv) > 2 else None
@@ -144,6 +177,8 @@ def main():
         run_train(dist, paddle, rank, world, out_file)
     if phase in ("all", "ps"):
         run_ps(dist, paddle, rank, world)
+    if phase in ("all", "rpc"):
+        run_rpc(dist, paddle, rank, world)
     print("WORKER_DONE", flush=True)
 
 

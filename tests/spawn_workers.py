@@ -1,0 +1,22 @@
+"""Module-level worker functions for dist.spawn tests (the spawn start
+method pickles targets by reference, so they must be importable)."""
+import json
+import os
+
+import numpy as np
+
+
+def allreduce_worker(out_dir):
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    r = dist.get_rank()
+    t = paddle.to_tensor(np.full((2,), float(r + 1), np.float32))
+    dist.all_reduce(t)
+    with open(os.path.join(out_dir, f"rank{r}.json"), "w") as f:
+        json.dump(np.asarray(t._array).tolist(), f)
+
+
+def failing_worker():
+    raise ValueError("boom from a rank")

@@ -1,0 +1,91 @@
+"""spawn + rpc + elastic tests (SURVEY items 27/30, VERDICT r2 missing
+#8): dist.spawn runs a 2-rank collective, rpc_sync/rpc_async work across
+2 launched processes, and the launcher's --max-restarts relaunches a
+failed pod.
+
+Reference analogs: python/paddle/distributed/spawn.py,
+python/paddle/distributed/rpc/rpc.py, fleet/elastic/manager.py:126.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "launch_worker.py")
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    return env
+
+
+def test_spawn_two_ranks(tmp_path):
+    from tests.spawn_workers import allreduce_worker
+
+    import paddle_tpu.distributed as dist
+
+    # spawn from inside the test process: fresh interpreters, cpu backend
+    dist.spawn(allreduce_worker, args=(str(tmp_path),), nprocs=2,
+               backend="cpu")
+    for r in range(2):
+        with open(tmp_path / f"rank{r}.json") as f:
+            got = json.load(f)
+        np.testing.assert_allclose(got, [3.0, 3.0])
+
+
+def test_spawn_surfaces_rank_failure():
+    from tests.spawn_workers import failing_worker
+
+    import paddle_tpu.distributed as dist
+
+    with pytest.raises(RuntimeError, match="boom from a rank"):
+        dist.spawn(failing_worker, nprocs=1, backend="cpu")
+
+
+def test_two_process_rpc():
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nprocs", "2", "--backend", "cpu", WORKER, "rpc"],
+        env=_env(), capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert res.stdout.count("ok rpc\n") == 2
+
+
+def test_elastic_restart(tmp_path):
+    script = tmp_path / "flaky.py"
+    sentinel = tmp_path / "attempted"
+    script.write_text(
+        "import os, sys\n"
+        f"s = {str(sentinel)!r}\n"
+        "if not os.path.exists(s):\n"
+        "    open(s, 'w').close()\n"
+        "    print('first attempt: failing', flush=True)\n"
+        "    sys.exit(3)\n"
+        "print('second attempt: ok', flush=True)\n")
+
+    # without restarts: pod failure propagates
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nprocs", "1", "--backend", "cpu", str(script)],
+        env=_env(), capture_output=True, text=True, timeout=300)
+    assert res.returncode != 0
+    os.unlink(sentinel)
+
+    # with --max-restarts 1: relaunched and succeeds
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nprocs", "1", "--backend", "cpu", "--max-restarts", "1",
+         str(script)],
+        env=_env(), capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert "restart 1/1" in res.stderr
+    assert "second attempt: ok" in res.stdout

@@ -16,7 +16,7 @@ from paddle_tpu.core import dtype as dtypes
 # ops that benefit from low precision (MXU ops) — the white list
 WHITE_LIST = {
     "matmul", "linear", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
-    "mm", "bmm", "einsum", "sdpa",
+    "mm", "bmm", "einsum", "sdpa", "resnet_stem_s2d",
 }
 
 # numerically sensitive ops that must stay fp32 — the black list

@@ -487,19 +487,67 @@ class TrainStep:
             self.optimizer._ensure_state()
             self._scan_jitted = self._build_scan()
             self._scan_epoch = debug_epoch()
+        xs = _unwrap(inputs_stacked)
+        ys = _unwrap(labels_stacked)
+        return self._dispatch_steps(
+            lambda pa, acc, lr, st, rng: self._scan_jitted(
+                pa, acc, lr, st, xs, ys, rng),
+            int(xs.shape[0]))
+
+    def run_repeat(self, inputs, labels, steps):
+        """Like run_scan but re-feeds ONE batch for `steps` steps inside
+        a single XLA program — throughput benchmarking without holding
+        `steps` copies of the data in HBM (a [steps, batch, ...] stack of
+        224px images overflows a chip long before compute does)."""
+        assert not self.with_outputs, \
+            "run_repeat returns losses only; use with_outputs=False"
+        from paddle_tpu.framework.flags import debug_epoch
+
+        xs = _unwrap(inputs)
+        ys = _unwrap(labels)
+        key = ("repeat", xs.shape, str(xs.dtype), debug_epoch())
+        if getattr(self, "_repeat_key", None) != key:
+            self.optimizer._ensure_state()
+            base_step = self._make_step_fn()
+
+            def repeat_all(param_arrays, accums, lr, step0, x, y, n, rng):
+                def body(carry, i):
+                    params, accs, st = carry
+                    loss, nparams, naccs = base_step(
+                        params, accs, lr, st, (x,), y,
+                        jax.random.fold_in(rng, st))
+                    return (nparams, naccs, st + 1), loss
+
+                (fp, fa, _), losses = jax.lax.scan(
+                    body, (param_arrays, accums, step0),
+                    jnp.arange(n, dtype=jnp.int32))
+                return losses, fp, fa
+
+            self._repeat_jitted = jax.jit(
+                repeat_all, static_argnames="n",
+                donate_argnums=(0, 1) if self._donate else ())
+            self._repeat_key = key
+        losses = self._dispatch_steps(
+            lambda pa, acc, lr, st, rng: self._repeat_jitted(
+                pa, acc, lr, st, xs, ys, steps, rng),
+            steps)
+        return losses
+
+    def _dispatch_steps(self, call, nsteps):
+        """Shared multi-step dispatch + writeback tail (run_scan and
+        run_repeat): gather live state, run, write params/accums back,
+        advance the step counter."""
         opt = self.optimizer
         param_arrays = [p._array for p in self._params]
         accums = self._gather_accums()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
-        xs = _unwrap(inputs_stacked)
-        ys = _unwrap(labels_stacked)
-        losses, new_params, new_accums = self._scan_jitted(
-            param_arrays, accums, lr, stepc, xs, ys, self._next_step_key())
+        losses, new_params, new_accums = call(
+            param_arrays, accums, lr, stepc, self._next_step_key())
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
         self._scatter_accums(new_accums)
-        opt._step_count += int(xs.shape[0])
+        opt._step_count += nsteps
         return Tensor._wrap(losses)
 
     def _build_scan(self):

@@ -339,24 +339,13 @@ def not_to_static(fn):
     return fn
 
 
-def build_step_fn(model, opt, loss_fn, params, acc_idx,
-                  with_outputs=False):
-    """The ONE compiled-train-step body shared by jit.TrainStep (single
-    device) and distributed.DistributedTrainStep (SPMD — which adds
-    shardings around it): value_and_grad over the model's eager forward
-    with params bound as traced args, grad clip, then the optimizer's
-    per-param update. Signature of the returned fn:
-    (param_arrays, accums, lr, step, inputs, label, rng) ->
-    (loss, new_params, new_accums) — or with_outputs=True:
-    ((loss, out), new_params, new_accums), the hapi train-metrics path
-    (outputs ride along as value_and_grad aux, no second forward)."""
+def make_forward_loss(model, loss_fn, params, with_outputs=False):
+    """The traced forward: bind param arrays into the live Parameters,
+    run the eager forward under the per-step rng, return the loss array
+    (optionally with the model outputs as aux). Shared by build_step_fn
+    and TrainStep's gradient-accumulation programs."""
     from paddle_tpu.core import random as random_mod
 
-    opt._ensure_state()
-    single_update = opt._single_update
-    accum_names = list(opt._accumulators.keys())
-    grad_clip = opt._grad_clip
-    extras_list = [opt._per_param_extras(j) for j in acc_idx]
     buffers = list(model.buffers()) if hasattr(model, "buffers") else []
 
     def forward_loss(param_arrays, inputs, label, rng):
@@ -375,10 +364,82 @@ def build_step_fn(model, opt, loss_fn, params, acc_idx,
                 return loss_arr, out_arrs
             return loss_arr
 
-    def step_fn(param_arrays, accums, lr, step, inputs, label, rng):
-        loss, grads = jax.value_and_grad(forward_loss,
-                                         has_aux=with_outputs)(
-            param_arrays, inputs, label, rng)
+    return forward_loss
+
+
+def make_update_fn(opt, acc_idx, params):
+    """The optimizer tail: clip + per-param single_update over merged
+    accumulator slots. (param_arrays, grads, accums, lr, step) ->
+    (new_params, new_accums). Shared by build_step_fn and the
+    gradient-merge apply program."""
+    opt._ensure_state()
+    single_update = opt._single_update
+    accum_names = list(opt._accumulators.keys())
+    grad_clip = opt._grad_clip
+    extras_list = [opt._per_param_extras(j) for j in acc_idx]
+
+    def update(param_arrays, grads, accums, lr, step, skip=None):
+        if grad_clip is not None:
+            # under pjit the norm reduction is mesh-global: XLA inserts
+            # the cross-shard collectives
+            # (hybrid_parallel_optimizer.py:186)
+            grads = grad_clip._clip_arrays(list(grads))
+        new_params, new_accums = [], {k: [] for k in accum_names}
+        for i, (p, g) in enumerate(zip(param_arrays, grads)):
+            acc_i = {k: accums[k][i] for k in accum_names}
+            np_, na = single_update(p, g, acc_i, lr, step,
+                                    extras=extras_list[i])
+            if skip is not None:
+                # skip the whole update on overflow (GradScaler.step
+                # semantics): params and opt state keep their old values
+                np_ = jnp.where(skip, p, np_)
+                na = {k: jnp.where(skip, acc_i[k], v)
+                      for k, v in na.items()}
+            new_params.append(np_)
+            for k in accum_names:
+                new_accums[k].append(na.get(k, acc_i[k]))
+        return new_params, new_accums
+
+    return update
+
+
+def build_step_fn(model, opt, loss_fn, params, acc_idx,
+                  with_outputs=False, with_scaler=False):
+    """The ONE compiled-train-step body shared by jit.TrainStep (single
+    device) and distributed.DistributedTrainStep (SPMD — which adds
+    shardings around it): value_and_grad over the model's eager forward
+    with params bound as traced args, grad clip, then the optimizer's
+    per-param update. Signature of the returned fn:
+    (param_arrays, accums, lr, step, inputs, label, rng) ->
+    (loss, new_params, new_accums) — or with_outputs=True:
+    ((loss, out), new_params, new_accums), the hapi train-metrics path
+    (outputs ride along as value_and_grad aux, no second forward)."""
+    forward_loss = make_forward_loss(model, loss_fn, params, with_outputs)
+    update = make_update_fn(opt, acc_idx, params)
+
+    def step_fn(param_arrays, accums, lr, step, inputs, label, rng,
+                scale=None):
+        if with_scaler:
+            # the UNSCALED loss rides along as aux, so the reported loss
+            # stays exact even when the scaled one overflows
+            def scaled_loss(pa, ins, lb, r):
+                out = forward_loss(pa, ins, lb, r)
+                if with_outputs:
+                    return out[0] * scale, out
+                return out * scale, out
+            (_, loss), grads = jax.value_and_grad(scaled_loss,
+                                                  has_aux=True)(
+                param_arrays, inputs, label, rng)
+            found_inf = jnp.logical_not(jnp.stack(
+                [jnp.all(jnp.isfinite(g)) for g in grads]).all())
+            # divide, don't multiply by 1/scale: at large scales the
+            # reciprocal is subnormal and XLA flushes it to zero
+            grads = [(g.astype(jnp.float32) / scale).astype(p.dtype)
+                     for g, p in zip(grads, param_arrays)]
+        else:
+            loss, grads = jax.value_and_grad(forward_loss,
+                                             has_aux=with_outputs)(
+                param_arrays, inputs, label, rng)
         from paddle_tpu.framework import nan_inf
 
         if nan_inf.check_enabled():
@@ -389,18 +450,11 @@ def build_step_fn(model, opt, loss_fn, params, acc_idx,
                 (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
                 for i, (p, g) in enumerate(zip(params, grads))]
             nan_inf.stage_check(named, "compiled train step")
-        if grad_clip is not None:
-            # under pjit the norm reduction is mesh-global: XLA inserts the
-            # cross-shard collectives (hybrid_parallel_optimizer.py:186)
-            grads = grad_clip._clip_arrays(list(grads))
-        new_params, new_accums = [], {k: [] for k in accum_names}
-        for i, (p, g) in enumerate(zip(param_arrays, grads)):
-            acc_i = {k: accums[k][i] for k in accum_names}
-            np_, na = single_update(p, g, acc_i, lr, step,
-                                    extras=extras_list[i])
-            new_params.append(np_)
-            for k in accum_names:
-                new_accums[k].append(na.get(k, acc_i[k]))
+        new_params, new_accums = update(
+            param_arrays, grads, accums, lr, step,
+            skip=found_inf if with_scaler else None)
+        if with_scaler:
+            return loss, found_inf, new_params, new_accums
         return loss, new_params, new_accums
 
     return step_fn
@@ -434,11 +488,26 @@ class TrainStep:
     """
 
     def __init__(self, model, optimizer, loss_fn=None, donate=True,
-                 with_outputs=False):
+                 with_outputs=False, accumulate_steps=1, scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.with_outputs = with_outputs
+        # gradient merge (GradientMergeOptimizer k_steps analog): grads
+        # from K successive micro-batch calls accumulate in device
+        # buffers; the optimizer applies the MEAN on the K-th call
+        self.accumulate_steps = int(accumulate_steps)
+        self._accum_count = 0
+        self._grad_bufs = None
+        # fp16 loss scaling (GradScaler) INSIDE the compiled step: scale
+        # loss, unscale grads, skip the update when any grad is non-finite
+        self.scaler = scaler
+        if scaler is not None and self.accumulate_steps > 1:
+            raise NotImplementedError(
+                "accumulate_steps with a GradScaler is not supported yet")
+        if with_outputs and self.accumulate_steps > 1:
+            raise NotImplementedError(
+                "accumulate_steps with with_outputs is not supported")
         optimizer._ensure_state()
         # The traced/updated set is the intersection of the model's
         # trainable params (stop_gradient=False — frozen params stay baked
@@ -469,10 +538,26 @@ class TrainStep:
 
         return random_mod.next_key()
 
+    def _with_scaler(self):
+        return self.scaler is not None and self.scaler.is_enable()
+
+    def _check_plain(self, what):
+        """Multi-step scan paths support neither loss scaling nor
+        gradient merge (the scan body applies a full update per step)."""
+        if self._with_scaler():
+            raise NotImplementedError(
+                f"{what} does not support a GradScaler; call the step "
+                "per batch instead")
+        if self.accumulate_steps > 1:
+            raise NotImplementedError(
+                f"{what} does not support accumulate_steps>1; call the "
+                "step per micro-batch instead")
+
     def _make_step_fn(self):
         return build_step_fn(self.model, self.optimizer, self.loss_fn,
                              self._params, self._acc_idx,
-                             with_outputs=self.with_outputs)
+                             with_outputs=self.with_outputs,
+                             with_scaler=self._with_scaler())
 
     def run_scan(self, inputs_stacked, labels_stacked):
         """Run a whole sequence of steps inside ONE XLA program via
@@ -501,6 +586,7 @@ class TrainStep:
         224px images overflows a chip long before compute does)."""
         assert not self.with_outputs, \
             "run_repeat returns losses only; use with_outputs=False"
+        self._check_plain("run_repeat")
         from paddle_tpu.framework.flags import debug_epoch
 
         xs = _unwrap(inputs)
@@ -533,6 +619,73 @@ class TrainStep:
             steps)
         return losses
 
+    def _build_accum_fns(self):
+        """Two programs for gradient merge: accumulate (forward+backward
+        into f32 buffers, no update) and apply (optimizer update from the
+        MEAN of the merged grads, buffers zeroed). All buffers donated.
+        Built from the same make_forward_loss/make_update_fn pieces as
+        the normal step so clip/nan-check behavior can't drift."""
+        from paddle_tpu.framework import nan_inf
+
+        forward_loss = make_forward_loss(self.model, self.loss_fn,
+                                         self._params)
+        update = make_update_fn(self.optimizer, self._acc_idx,
+                                self._params)
+        params = self._params
+        K = self.accumulate_steps
+
+        def acc_fn(bufs, param_arrays, inputs, label, rng):
+            loss, grads = jax.value_and_grad(forward_loss)(
+                param_arrays, inputs, label, rng)
+            if nan_inf.check_enabled():
+                named = [("loss", loss)] + [
+                    (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
+                    for i, (p, g) in enumerate(zip(params, grads))]
+                nan_inf.stage_check(named, "gradient-merge micro-step")
+            return loss, [b + g.astype(jnp.float32)
+                          for b, g in zip(bufs, grads)]
+
+        def upd_fn(param_arrays, accums, bufs, lr, step):
+            grads = [(b / K).astype(p.dtype)
+                     for b, p in zip(bufs, param_arrays)]
+            new_params, new_accums = update(param_arrays, grads, accums,
+                                            lr, step)
+            zeroed = [jnp.zeros_like(b) for b in bufs]
+            return new_params, new_accums, zeroed
+
+        donate = (0,) if self._donate else ()
+        return (jax.jit(acc_fn, donate_argnums=donate),
+                jax.jit(upd_fn, donate_argnums=(0, 1, 2)
+                        if self._donate else ()))
+
+    def _call_accumulate(self, in_arrays, label_arr):
+        from paddle_tpu.framework.flags import debug_epoch
+
+        opt = self.optimizer
+        if getattr(self, "_acc_jitted", None) is None or \
+                getattr(self, "_acc_epoch", None) != debug_epoch():
+            self._acc_jitted, self._upd_jitted = self._build_accum_fns()
+            self._acc_epoch = debug_epoch()
+        if self._grad_bufs is None:
+            self._grad_bufs = [jnp.zeros(p._array.shape, jnp.float32)
+                               for p in self._params]
+        loss, self._grad_bufs = self._acc_jitted(
+            self._grad_bufs, [p._array for p in self._params],
+            in_arrays, label_arr, self._next_step_key())
+        self._accum_count += 1
+        if self._accum_count >= self.accumulate_steps:
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            stepc = jnp.asarray(opt._step_count, jnp.int32)
+            new_params, new_accums, self._grad_bufs = self._upd_jitted(
+                [p._array for p in self._params],
+                self._gather_accums(), self._grad_bufs, lr, stepc)
+            for p, a in zip(self._params, new_params):
+                p._in_place_update(a)
+            self._scatter_accums(new_accums)
+            opt._step_count += 1
+            self._accum_count = 0
+        return Tensor._wrap(loss)
+
     def _dispatch_steps(self, call, nsteps):
         """Shared multi-step dispatch + writeback tail (run_scan and
         run_repeat): gather live state, run, write params/accums back,
@@ -553,6 +706,7 @@ class TrainStep:
     def _build_scan(self):
         assert not self.with_outputs, \
             "run_scan returns losses only; use with_outputs=False"
+        self._check_plain("run_scan")
         base_step = self._make_step_fn()
 
         def scan_all(param_arrays, accums, lr, step0, xs, ys, rng):
@@ -577,26 +731,43 @@ class TrainStep:
             inputs = tuple(inputs)
         from paddle_tpu.framework.flags import debug_epoch
 
+        build_key = (debug_epoch(), self._with_scaler())
         if self._jitted is None or \
-                getattr(self, "_flags_epoch", None) != debug_epoch():
+                getattr(self, "_build_key", None) != build_key:
             self.optimizer._ensure_state()
             self._jitted = self._build()
             self._scan_jitted = None
-            self._flags_epoch = debug_epoch()
+            self._build_key = build_key
         opt = self.optimizer
+        in_arrays = tuple(_unwrap(i) for i in inputs)
+        label_arr = _unwrap(label) if label is not None else None
+        if self.accumulate_steps > 1:
+            return self._call_accumulate(in_arrays, label_arr)
         param_arrays = [p._array for p in self._params]
         accums = self._gather_accums()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
-        in_arrays = tuple(_unwrap(i) for i in inputs)
-        label_arr = _unwrap(label) if label is not None else None
-        loss, new_params, new_accums = self._jitted(
-            param_arrays, accums, lr, stepc, in_arrays, label_arr,
-            self._next_step_key())
+        if self._with_scaler():
+            loss, found_inf, new_params, new_accums = self._jitted(
+                param_arrays, accums, lr, stepc, in_arrays, label_arr,
+                self._next_step_key(),
+                jnp.float32(self.scaler.get_scale()))
+            skipped = bool(found_inf)
+            self.scaler._found_inf = skipped
+            self.scaler.update()
+        else:
+            loss, new_params, new_accums = self._jitted(
+                param_arrays, accums, lr, stepc, in_arrays, label_arr,
+                self._next_step_key())
+            skipped = False
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
         self._scatter_accums(new_accums)
-        opt._step_count += 1
+        if not skipped:
+            # a scaler-skipped step doesn't count (GradScaler.step skips
+            # optimizer.step entirely — bias-correction t must match the
+            # number of REAL updates the moments saw)
+            opt._step_count += 1
         if self.with_outputs:
             loss, out = loss
             return Tensor._wrap(loss), jax.tree_util.tree_map(Tensor._wrap, out)

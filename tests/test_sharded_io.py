@@ -1,0 +1,69 @@
+"""Sharded checkpoint tests (VERDICT item 41: no sharded/per-host
+checkpoint): save a stage-3 sharded model's shards, reload replicated,
+reload onto a DIFFERENT sharding, bf16 roundtrip.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                    set_hybrid_communicate_group)
+from paddle_tpu.framework.sharded_io import load_sharded, save_sharded
+
+
+def test_sharded_roundtrip_and_reshard(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    hcg = HybridCommunicateGroup(sharding=8)
+    set_hybrid_communicate_group(hcg)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 16))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    step = dist.DistributedTrainStep(net, opt,
+                                     lambda o, t: F.mse_loss(o, t),
+                                     hcg=hcg, sharding_stage=3)
+    x = paddle.randn([8, 16])
+    y = paddle.randn([8, 16])
+    step(x, y)  # params now sharded over 'sharding'
+    ref = {k: np.asarray(v._array) for k, v in net.state_dict().items()}
+    assert any("sharding" in str(v._array.sharding.spec)
+               for v in net.state_dict().values())
+
+    ck = str(tmp_path / "ck")
+    save_sharded(net.state_dict(), ck)
+
+    # plain reload: full numpy arrays
+    loaded = load_sharded(ck)
+    for k, v in ref.items():
+        np.testing.assert_array_equal(np.asarray(loaded[k]), v)
+
+    # reshard-on-load: different layout (axis-1 sharding of the weights)
+    mesh = hcg.mesh
+    shardings = {k: NamedSharding(mesh, P(None, "sharding"))
+                 if np.ndim(ref[k]) == 2 and ref[k].shape[1] % 8 == 0
+                 else NamedSharding(mesh, P())
+                 for k in ref}
+    res = load_sharded(ck, shardings=shardings)
+    for k, v in ref.items():
+        np.testing.assert_array_equal(np.asarray(res[k]), v)
+    w0 = res["0.weight"]
+    assert "sharding" in str(w0.sharding.spec)
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+
+
+def test_sharded_bf16_roundtrip(tmp_path):
+    paddle.seed(1)
+    net = nn.Linear(8, 8)
+    net.to(dtype="bfloat16")
+    ck = str(tmp_path / "ckbf")
+    save_sharded(net.state_dict(), ck)
+    loaded = load_sharded(ck)
+    for k, v in net.state_dict().items():
+        assert str(loaded[k].dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(loaded[k], np.float32),
+            np.asarray(v._array, np.float32), err_msg=k)

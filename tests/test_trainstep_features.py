@@ -1,0 +1,97 @@
+"""Gradient accumulation + compiled GradScaler tests (VERDICT r2 #22
+gradient-merge gap and weak #8 eager-only found_inf).
+
+Reference analogs: fleet/meta_optimizers/gradient_merge_optimizer.py,
+python/paddle/amp/grad_scaler.py + amp_optimizer static insertion.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.jit as jit
+from paddle_tpu.amp import GradScaler
+
+
+def _net(seed):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    return net, opt
+
+
+def test_grad_accumulation_matches_large_batch():
+    rs = np.random.RandomState(0)
+    micro = [(rs.randn(8, 8).astype(np.float32),
+              rs.randn(8, 4).astype(np.float32)) for _ in range(4)]
+    big_x = np.concatenate([m[0] for m in micro])
+    big_y = np.concatenate([m[1] for m in micro])
+
+    # reference: one step on the 32-sample batch
+    net_a, opt_a = _net(7)
+    step_a = jit.TrainStep(net_a, opt_a, F.mse_loss)
+    step_a(paddle.to_tensor(big_x), paddle.to_tensor(big_y))
+
+    # gradient merge: 4 micro-steps of 8
+    net_b, opt_b = _net(7)
+    step_b = jit.TrainStep(net_b, opt_b, F.mse_loss, accumulate_steps=4)
+    w0 = np.asarray(net_b[0].weight._array).copy()
+    for i, (x, y) in enumerate(micro):
+        step_b(paddle.to_tensor(x), paddle.to_tensor(y))
+        if i < 3:
+            # params untouched until the K-th micro-batch
+            np.testing.assert_array_equal(
+                np.asarray(net_b[0].weight._array), w0)
+    assert opt_b._step_count == 1
+
+    for (ka, va), (kb, vb) in zip(net_a.state_dict().items(),
+                                  net_b.state_dict().items()):
+        np.testing.assert_allclose(np.asarray(va._array),
+                                   np.asarray(vb._array),
+                                   rtol=1e-5, atol=1e-6, err_msg=ka)
+
+
+def test_grad_accumulation_trains():
+    rs = np.random.RandomState(1)
+    net, opt = _net(3)
+    step = jit.TrainStep(net, opt, F.mse_loss, accumulate_steps=2)
+    x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+    losses = [float(step(x, y)) for _ in range(8)]  # 4 real updates
+    assert losses[-1] < losses[0]
+    assert opt._step_count == 4
+
+
+def test_scaler_skips_update_on_overflow():
+    net, opt = _net(5)
+    # absurd scale: scaled grads overflow fp32 -> found_inf
+    scaler = GradScaler(init_loss_scaling=1e38, incr_ratio=2.0,
+                        decr_ratio=0.5, decr_every_n_nan_or_inf=1)
+    step = jit.TrainStep(net, opt, F.mse_loss, scaler=scaler)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.full((4, 4), 1e3, np.float32))  # big loss
+    w0 = np.asarray(net[0].weight._array).copy()
+    step(x, y)
+    # update skipped, scale halved
+    np.testing.assert_array_equal(np.asarray(net[0].weight._array), w0)
+    assert scaler.get_scale() == pytest.approx(0.5e38)
+
+
+def test_scaler_trains_when_finite():
+    net, opt = _net(6)
+    scaler = GradScaler(init_loss_scaling=1024.0)
+    step = jit.TrainStep(net, opt, F.mse_loss, scaler=scaler)
+    rs = np.random.RandomState(2)
+    x = paddle.to_tensor(rs.randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+    losses = [float(step(x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert scaler.get_scale() == 1024.0  # no overflow, no decrease
+
+    # parity with an unscaled step: same seed, same data
+    net2, opt2 = _net(6)
+    step2 = jit.TrainStep(net2, opt2, F.mse_loss)
+    losses2 = [float(step2(x, y)) for _ in range(6)]
+    np.testing.assert_allclose(losses, losses2, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,214 @@
+// Native batch loader — the C++ runtime piece of the input pipeline.
+//
+// Reference analog: the C++ DataLoader core (paddle/fluid/framework/
+// data_feed.cc, reader/buffered_reader.cc): batch assembly and shuffling
+// run in native worker threads, overlapping with Python/JAX work instead
+// of fighting the GIL. Python keeps the policy (datasets, transforms);
+// this keeps the mechanism: gather rows of a contiguous array into
+// batch buffers, prefetched into a bounded queue.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this toolchain):
+//   fl_create(data, n_items, item_bytes, batch, drop_last, shuffle,
+//             seed, prefetch, workers) -> handle
+//   fl_next(handle, out_buf, out_count) -> 1 ok / 0 epoch end
+//   fl_epoch(handle)   — reshuffle + restart
+//   fl_destroy(handle)
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<uint8_t> buf;
+  int64_t count = 0;
+  int64_t seq = 0;
+};
+
+struct Loader {
+  const uint8_t* data;
+  int64_t n_items, item_bytes, batch;
+  bool drop_last, shuffle;
+  uint64_t seed;
+  int64_t prefetch;
+  int n_workers;
+
+  std::vector<int64_t> order;
+  std::atomic<int64_t> next_batch_idx{0};  // claimed by workers
+  int64_t n_batches = 0;
+  int64_t epoch = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  // min-heap on seq so batches come out in deterministic order even
+  // with racing workers
+  struct Cmp {
+    bool operator()(const Batch* a, const Batch* b) const {
+      return a->seq > b->seq;
+    }
+  };
+  std::priority_queue<Batch*, std::vector<Batch*>, Cmp> ready;
+  int64_t next_out_seq = 0;
+  int64_t inflight = 0;
+  int64_t building = 0;  // workers between claim and push
+  bool stopping = false;
+
+  std::vector<std::thread> workers;
+
+  void shuffle_order() {
+    order.resize(n_items);
+    for (int64_t i = 0; i < n_items; i++) order[i] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed + static_cast<uint64_t>(epoch));
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+    int64_t full = n_items / batch;
+    n_batches = drop_last ? full : (n_items + batch - 1) / batch;
+  }
+
+  void worker() {
+    for (;;) {
+      int64_t bi;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] { return stopping || inflight < prefetch; });
+        if (stopping) return;
+        bi = next_batch_idx.load();
+        if (bi >= n_batches) {
+          cv_get.notify_all();
+          // park until the next epoch resets next_batch_idx
+          cv_put.wait(lk, [&] {
+            return stopping || next_batch_idx.load() < n_batches;
+          });
+          if (stopping) return;
+          continue;
+        }
+        // claim under the mutex so new_epoch() can quiesce by halting
+        // claims and waiting for building == 0
+        next_batch_idx.store(bi + 1);
+        inflight++;
+        building++;
+      }
+      auto* b = new Batch;
+      int64_t start = bi * batch;
+      int64_t cnt = std::min(batch, n_items - start);
+      b->count = cnt;
+      b->seq = bi;
+      b->buf.resize(static_cast<size_t>(cnt) * item_bytes);
+      for (int64_t r = 0; r < cnt; r++) {
+        std::memcpy(b->buf.data() + r * item_bytes,
+                    data + order[start + r] * item_bytes,
+                    static_cast<size_t>(item_bytes));
+      }
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        ready.push(b);
+        building--;
+        cv_get.notify_all();
+      }
+    }
+  }
+
+  int next(uint8_t* out, int64_t* out_count) {
+    std::unique_lock<std::mutex> lk(mu);
+    if (next_out_seq >= n_batches) return 0;  // epoch complete
+    cv_get.wait(lk, [&] {
+      return stopping ||
+             (!ready.empty() && ready.top()->seq == next_out_seq);
+    });
+    if (stopping) return 0;
+    Batch* b = ready.top();
+    ready.pop();
+    inflight--;
+    next_out_seq++;
+    cv_put.notify_all();
+    lk.unlock();
+    std::memcpy(out, b->buf.data(), b->buf.size());
+    *out_count = b->count;
+    delete b;
+    return 1;
+  }
+
+  void new_epoch() {
+    std::unique_lock<std::mutex> lk(mu);
+    // quiesce: halt new claims, wait for mid-build workers to finish
+    // (they read `order`, which shuffle_order() is about to rewrite,
+    // and would otherwise push stale-seq batches after the drain)
+    next_batch_idx.store(n_batches);
+    cv_put.notify_all();
+    cv_get.wait(lk, [&] { return stopping || building == 0; });
+    while (!ready.empty()) {
+      delete ready.top();
+      ready.pop();
+    }
+    epoch++;
+    inflight = 0;
+    next_out_seq = 0;
+    shuffle_order();
+    next_batch_idx.store(0);
+    cv_put.notify_all();
+  }
+
+  void stop() {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      stopping = true;
+      cv_put.notify_all();
+      cv_get.notify_all();
+    }
+    for (auto& t : workers) t.join();
+    std::unique_lock<std::mutex> lk(mu);
+    while (!ready.empty()) {
+      delete ready.top();
+      ready.pop();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* fl_create(const void* data, int64_t n_items, int64_t item_bytes,
+                int64_t batch, int drop_last, int shuffle, uint64_t seed,
+                int64_t prefetch, int workers) {
+  auto* L = new Loader;
+  L->data = static_cast<const uint8_t*>(data);
+  L->n_items = n_items;
+  L->item_bytes = item_bytes;
+  L->batch = batch;
+  L->drop_last = drop_last != 0;
+  L->shuffle = shuffle != 0;
+  L->seed = seed;
+  L->prefetch = prefetch < 1 ? 1 : prefetch;
+  L->n_workers = workers < 1 ? 1 : workers;
+  L->shuffle_order();
+  for (int i = 0; i < L->n_workers; i++)
+    L->workers.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+int64_t fl_num_batches(void* h) { return static_cast<Loader*>(h)->n_batches; }
+
+int fl_next(void* h, void* out, int64_t* out_count) {
+  return static_cast<Loader*>(h)->next(static_cast<uint8_t*>(out),
+                                       out_count);
+}
+
+void fl_epoch(void* h) { static_cast<Loader*>(h)->new_epoch(); }
+
+void fl_destroy(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  L->stop();
+  delete L;
+}
+
+}  // extern "C"

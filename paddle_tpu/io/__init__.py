@@ -17,9 +17,11 @@ from .sampler import (
     WeightedRandomSampler,
 )
 
+from .native import NativeArrayLoader, native_available
+
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "Subset", "random_split", "DataLoader", "BatchSampler",
     "DistributedBatchSampler", "Sampler", "RandomSampler", "SequenceSampler",
-    "WeightedRandomSampler",
+    "WeightedRandomSampler", "NativeArrayLoader", "native_available",
 ]

@@ -1,4 +1,4 @@
 """paddle.vision analog (python/paddle/vision/)."""
-from . import datasets, models, transforms
+from . import datasets, models, ops, transforms
 
-__all__ = ["datasets", "models", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms"]

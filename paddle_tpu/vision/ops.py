@@ -1,0 +1,157 @@
+"""paddle.vision.ops analog — detection ops (nms, distribute route of
+PP-YOLOE-style postprocessing).
+
+Reference analog: python/paddle/vision/ops.py (nms), the NMS kernels
+(paddle/phi/kernels/cpu/nms_kernel.cc, gpu/nms_kernel.cu) and
+multiclass_nms (phi/kernels/cpu/multiclass_nms3_kernel.cc).
+
+TPU-native design: the core is a FIXED-SHAPE jittable suppressor —
+an [N,N] IoU matrix plus a lax.fori_loop greedy selection, returning
+[max_out] indices with a validity mask (XLA needs static shapes; the
+reference's dynamic-length outputs become a (indices, mask) pair).
+The eager `nms()` wrapper trims to the dynamic length for paddle
+parity. Class-aware NMS uses the coordinate-offset trick so one fixed
+suppressor serves multiclass heads.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["box_iou", "nms", "multiclass_nms", "nms_fixed"]
+
+
+def _arr(x):
+    return x._array if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix [N,M] for xyxy boxes."""
+    a, b = _arr(boxes1), _arr(boxes2)
+
+    def fn(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-9)
+
+    return Tensor._wrap(fn(a, b))
+
+
+@functools.partial(jax.jit, static_argnames=("max_out",))
+def nms_fixed(boxes, scores, iou_threshold, max_out):
+    """Fixed-shape greedy NMS: ([N,4], [N]) ->
+    (indices [max_out] int32 (-1 padded), valid [max_out] bool).
+    Jittable — usable inside compiled detection heads."""
+    n = boxes.shape[0]
+    iou = _arr(box_iou(boxes, boxes))
+    order_scores = scores
+
+    def body(k, state):
+        alive, idxs, valid = state
+        masked = jnp.where(alive, order_scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        idxs = idxs.at[k].set(jnp.where(ok, best, -1))
+        valid = valid.at[k].set(ok)
+        # suppress the chosen box and its high-IoU neighbours
+        suppress = (iou[best] >= iou_threshold) | \
+            (jnp.arange(n) == best)
+        alive = alive & jnp.where(ok, ~suppress, alive)
+        return alive, idxs, valid
+
+    alive0 = jnp.ones((n,), bool)
+    idxs0 = jnp.full((max_out,), -1, jnp.int32)
+    valid0 = jnp.zeros((max_out,), bool)
+    _, idxs, valid = jax.lax.fori_loop(0, max_out, body,
+                                       (alive0, idxs0, valid0))
+    return idxs, valid
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """paddle.vision.ops.nms parity (eager: returns the kept indices,
+    dynamic length). With category_idxs, suppression is per-class
+    (coordinate offset trick)."""
+    b = _arr(boxes).astype(jnp.float32)
+    s = None if scores is None else _arr(scores).astype(jnp.float32)
+    cat = None if category_idxs is None \
+        else _arr(category_idxs)
+    sel = None
+    if categories is not None and cat is not None:
+        # paddle semantics: suppression runs only over the listed
+        # categories; other boxes are excluded from the result
+        keep_mask = np.isin(np.asarray(cat), np.asarray(categories))
+        sel = np.nonzero(keep_mask)[0]
+        b = b[jnp.asarray(sel)]
+        cat = cat[jnp.asarray(sel)]
+        if s is not None:
+            s = s[jnp.asarray(sel)]
+    n = b.shape[0]
+    if n == 0:
+        return Tensor._wrap(jnp.zeros((0,), jnp.int32))
+    if s is None:
+        s = jnp.arange(n, 0, -1, dtype=jnp.float32)
+    if cat is not None:
+        span = (b.max() - b.min()) + 1.0
+        b = b + (cat.astype(jnp.float32) * span)[:, None]  # no overlap
+    # pad N and max_out to power-of-two buckets: box counts are
+    # data-dependent, and an exact-N jit would recompile per image
+    bucket = 1 << max(int(n - 1).bit_length(), 3)
+    if bucket != n:
+        b = jnp.concatenate([b, jnp.zeros((bucket - n, 4), b.dtype)])
+        s = jnp.concatenate([s, jnp.full((bucket - n,), -jnp.inf,
+                                         s.dtype)])
+    want = n if top_k is None or int(top_k) < 0 else min(int(top_k), n)
+    max_out = 1 << max(int(want - 1).bit_length(), 3)
+    idxs, valid = nms_fixed(b, s, jnp.float32(iou_threshold), max_out)
+    kept = np.asarray(idxs)[np.asarray(valid)][:want]
+    if sel is not None:
+        kept = sel[kept]  # map back to original indexing
+    return Tensor._wrap(jnp.asarray(kept, jnp.int32))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.45,
+                   background_label=-1):
+    """multiclass_nms3 analog for one image: bboxes [N,4],
+    scores [C,N] -> (out [K,6] (label, score, x1,y1,x2,y2), K).
+    Fixed-shape inner NMS per the TPU design; assembly is eager."""
+    b = np.asarray(_arr(bboxes), np.float32)
+    sc = np.asarray(_arr(scores), np.float32)
+    C, N = sc.shape
+    all_boxes, all_scores, all_cats = [], [], []
+    for c in range(C):
+        if c == background_label:
+            continue
+        m = sc[c] >= score_threshold
+        if not m.any():
+            continue
+        idx = np.nonzero(m)[0]
+        if len(idx) > nms_top_k:
+            idx = idx[np.argsort(-sc[c][idx])[:nms_top_k]]
+        all_boxes.append(b[idx])
+        all_scores.append(sc[c][idx])
+        all_cats.append(np.full(len(idx), c, np.int64))
+    if not all_boxes:
+        return Tensor._wrap(jnp.zeros((0, 6), jnp.float32)), 0
+    cb = np.concatenate(all_boxes)
+    cs = np.concatenate(all_scores)
+    cc = np.concatenate(all_cats)
+    kept = np.asarray(nms(cb, nms_threshold, scores=cs, category_idxs=cc,
+                          top_k=keep_top_k)._array)
+    out = np.concatenate(
+        [cc[kept, None].astype(np.float32), cs[kept, None], cb[kept]],
+        axis=1)
+    order = np.argsort(-out[:, 1])
+    out = out[order]
+    return Tensor._wrap(jnp.asarray(out)), len(out)

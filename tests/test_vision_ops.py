@@ -1,0 +1,118 @@
+"""Detection-op tests (BASELINE PP-YOLOE functional row): NMS against a
+numpy reference, class-aware NMS, the fixed-shape jittable core, and
+multiclass_nms assembly.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.ops import box_iou, multiclass_nms, nms, nms_fixed
+
+
+def _np_nms(boxes, scores, thr):
+    """Reference O(N^2) NMS."""
+    order = np.argsort(-scores)
+    keep = []
+    while len(order):
+        i = order[0]
+        keep.append(i)
+        if len(order) == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = (boxes[order[1:], 2] - boxes[order[1:], 0]) * \
+             (boxes[order[1:], 3] - boxes[order[1:], 1])
+        iou = inter / (a1 + a2 - inter + 1e-9)
+        order = order[1:][iou < thr]
+    return np.array(keep)
+
+
+def _random_boxes(n, seed):
+    rs = np.random.RandomState(seed)
+    xy = rs.uniform(0, 90, (n, 2)).astype(np.float32)
+    wh = rs.uniform(5, 30, (n, 2)).astype(np.float32)
+    return np.concatenate([xy, xy + wh], axis=1)
+
+
+def test_box_iou_known_values():
+    a = np.array([[0, 0, 10, 10]], np.float32)
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                 np.float32)
+    iou = np.asarray(box_iou(a, b)._array)
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], rtol=1e-5)
+
+
+def test_nms_matches_numpy_reference():
+    for seed in range(5):
+        boxes = _random_boxes(60, seed)
+        scores = np.random.RandomState(100 + seed) \
+            .uniform(size=60).astype(np.float32)
+        for thr in (0.3, 0.5, 0.7):
+            got = np.asarray(nms(boxes, thr, scores=scores)._array)
+            want = _np_nms(boxes, scores, thr)
+            np.testing.assert_array_equal(got, want)
+
+
+def test_nms_class_aware():
+    # identical overlapping boxes in different classes both survive
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+    scores = np.array([0.9, 0.8], np.float32)
+    cats = np.array([0, 1])
+    kept = np.asarray(nms(boxes, 0.3, scores=scores, category_idxs=cats,
+                          categories=[0, 1])._array)
+    assert len(kept) == 2
+    # same class: the lower-scored one is suppressed
+    kept2 = np.asarray(nms(boxes, 0.3, scores=scores)._array)
+    np.testing.assert_array_equal(kept2, [0])
+
+
+def test_nms_fixed_is_jittable_inside_program():
+    boxes = jnp.asarray(_random_boxes(32, 3))
+    scores = jnp.asarray(np.random.RandomState(9)
+                         .uniform(size=32).astype(np.float32))
+
+    @jax.jit
+    def head(b, s):
+        idxs, valid = nms_fixed(b, s, jnp.float32(0.5), 10)
+        return idxs, valid
+
+    idxs, valid = head(boxes, scores)
+    assert idxs.shape == (10,)
+    want = _np_nms(np.asarray(boxes), np.asarray(scores), 0.5)[:10]
+    np.testing.assert_array_equal(np.asarray(idxs)[np.asarray(valid)],
+                                  want)
+
+
+def test_nms_categories_filter_and_keep_all():
+    boxes = np.array([[0, 0, 10, 10], [20, 20, 30, 30], [40, 40, 50, 50]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    cats = np.array([0, 1, 2])
+    # only classes 0 and 2 participate; class-1 box excluded entirely
+    kept = np.asarray(nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                          categories=[0, 2])._array)
+    np.testing.assert_array_equal(sorted(kept), [0, 2])
+    # top_k=-1 is paddle's keep-all convention
+    kept2 = np.asarray(nms(boxes, 0.5, scores=scores, top_k=-1)._array)
+    assert len(kept2) == 3
+
+
+def test_multiclass_nms():
+    boxes = _random_boxes(40, 5)
+    rs = np.random.RandomState(6)
+    scores = rs.uniform(size=(3, 40)).astype(np.float32)
+    out, k = multiclass_nms(boxes, scores, score_threshold=0.5,
+                            nms_threshold=0.5, keep_top_k=20)
+    out = np.asarray(out._array)
+    assert out.shape[0] == k <= 20 and out.shape[1] == 6
+    # sorted by score desc, labels in range, scores above threshold
+    assert (np.diff(out[:, 1]) <= 1e-6).all()
+    assert ((out[:, 0] >= 0) & (out[:, 0] <= 2)).all()
+    assert (out[:, 1] >= 0.5).all()

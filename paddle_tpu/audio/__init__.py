@@ -1,0 +1,7 @@
+"""paddle.audio analog (python/paddle/audio/): feature layers +
+functional DSP math, jnp-native so it compiles with the model."""
+from . import features, functional
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram
+
+__all__ = ["features", "functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,127 @@
+"""paddle.audio.features analog (audio/features/layers.py):
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC as nn.Layers.
+
+TPU-native: framing is one strided gather and the STFT is a batched
+rfft — everything stays jnp, so the whole feature pipeline compiles
+into the model's program (contrast the reference's eager kaldi-style
+CPU featurization)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply
+
+from .functional import (compute_fbank_matrix, create_dct, get_window,
+                         power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center, pad_mode):
+    if center:
+        pad = frame_length // 2
+        widths = [(0, 0)] * (x.ndim - 1) + [(pad, pad)]
+        x = jnp.pad(x, widths, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(n_frames)[:, None])
+    return x[..., idx]  # [..., n_frames, frame_length]
+
+
+class Spectrogram(nn.Layer):
+    """|STFT|^power: [..., T] -> [..., n_fft//2+1, n_frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length,
+                       dtype=jnp.dtype(dtype))
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self.window = w
+        self.dtype = jnp.dtype(dtype)
+
+    def forward(self, x):
+        win, n_fft, hop = self.window, self.n_fft, self.hop_length
+
+        def fn(a):
+            frames = _frame(a, n_fft, hop, self.center, self.pad_mode)
+            spec = jnp.fft.rfft((frames * win).astype(self.dtype),
+                                n=n_fft, axis=-1)
+            mag = jnp.abs(spec) ** self.power
+            return jnp.swapaxes(mag, -1, -2).astype(self.dtype)
+
+        return apply("spectrogram", fn,
+                     x if isinstance(x, Tensor) else Tensor(x))
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype=dtype)
+        self.fbank = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                          htk, norm).astype(
+                                              jnp.dtype(dtype))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self.fbank
+        return apply("mel_spectrogram",
+                     lambda s: jnp.einsum("mf,...ft->...mt", fb, s), spec)
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm, dtype=dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+        return apply("log_mel",
+                     lambda s: power_to_db(s, self.ref_value, self.amin,
+                                           self.top_db), m)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype=dtype)
+        self.dct = create_dct(n_mfcc, n_mels).astype(jnp.dtype(dtype))
+
+    def forward(self, x):
+        lm = self.log_mel(x)
+        dct = self.dct
+        return apply("mfcc",
+                     lambda s: jnp.einsum("mk,...mt->...kt", dct, s), lm)

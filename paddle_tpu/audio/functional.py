@@ -1,0 +1,115 @@
+"""paddle.audio.functional analog (audio/functional/functional.py,
+window.py): windows, mel filterbanks, dct, dB conversion — jnp math so
+feature extraction fuses into the same XLA program as the model."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct"]
+
+
+def get_window(window, win_length, fftbins=True, dtype=jnp.float32):
+    """hann/hamming/blackman/bartlett/ones (window.py get_window)."""
+    if isinstance(window, (tuple, list)):
+        window = window[0]
+    n = win_length
+    # periodic (fftbins=True) windows divide by n, symmetric by n-1
+    d = n if fftbins else max(n - 1, 1)
+    k = jnp.arange(n, dtype=dtype)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * k / d)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * k / d)
+    elif window == "blackman":
+        w = 0.42 - 0.5 * jnp.cos(2 * math.pi * k / d) \
+            + 0.08 * jnp.cos(4 * math.pi * k / d)
+    elif window == "bartlett":
+        w = 1.0 - jnp.abs(2 * k / d - 1.0)
+    elif window in ("ones", "boxcar", "rectangular"):
+        w = jnp.ones((n,), dtype)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(dtype)
+
+
+def hz_to_mel(freq, htk=False):
+    f = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + f / 700.0)
+    # slaney scale (librosa default, matches the reference)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                           / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk=False):
+    m = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(m >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return jnp.linspace(0, sr / 2, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """Triangular mel filterbank [n_mels, n_fft//2+1]."""
+    f_max = f_max or sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb = fb * enorm[:, None]
+    return fb
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s)) \
+        - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """DCT-II matrix [n_mels, n_mfcc] (functional.create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct = dct * jnp.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].multiply(1.0 / jnp.sqrt(2.0))
+    else:
+        dct = dct * 2.0
+    return dct

@@ -1,0 +1,87 @@
+"""paddle.text analog (python/paddle/text/): viterbi_decode + a
+ViterbiDecoder layer.
+
+TPU-native: the Viterbi dynamic program is two lax.scans (forward
+max-product with backpointers, backward path recovery) over the time
+axis — fixed shapes, no host loop, batch-vectorized, jittable inside
+compiled tagging heads. Reference: python/paddle/text/viterbi_decode.py,
+kernel phi/kernels/cpu/viterbi_decode_kernel.cc (start tag = last
+transitions row, stop tag = second-to-last column when
+include_bos_eos_tag).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply_nograd
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(potentials, trans, lengths, include_bos_eos_tag):
+    B, T, N = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+
+    alpha0 = potentials[:, 0]
+    if include_bos_eos_tag:
+        alpha0 = alpha0 + trans[-1][None, :]  # from the start tag
+
+    def fwd(alpha, t):
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, t, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)                 # [B, N]
+        best_score = jnp.max(scores, axis=1) + potentials[:, t]
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, best_score, alpha)
+        return alpha, jnp.where(active, best_prev, -1)
+
+    alpha, backptrs = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+    # backptrs: [T-1, B, N]
+    final = alpha + (trans[:, -2][None, :] if include_bos_eos_tag else 0.0)
+    scores = jnp.max(final, axis=1)
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)     # [B]
+
+    def bwd(tag, t):
+        bp = backptrs[t]                                       # [B, N]
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # before a sequence's end, follow the pointer; at/after, hold
+        follow = (t + 1) < lengths
+        new_tag = jnp.where(follow & (prev >= 0),
+                            prev.astype(jnp.int32), tag)
+        return new_tag, new_tag
+
+    _, rev_path = jax.lax.scan(bwd, last_tag,
+                               jnp.arange(T - 2, -1, -1))
+    path = jnp.concatenate(
+        [jnp.flip(rev_path, axis=0), last_tag[None, :]]).T     # [B, T]
+    # zero out positions at/after each sequence's length (kernel parity)
+    mask = jnp.arange(T)[None, :] < lengths[:, None]
+    path = jnp.where(mask, path, 0)
+    return scores, path.astype(jnp.int32)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """(scores [B], paths [B, T]) — highest-scoring tag sequences."""
+    return apply_nograd(
+        "viterbi_decode",
+        lambda p, tr, ln: _viterbi(p, tr, ln, include_bos_eos_tag),
+        *(x if isinstance(x, Tensor) else Tensor(x)
+          for x in (potentials, transition_params, lengths)))
+
+
+class ViterbiDecoder(nn.Layer):
+    """Layer form (python/paddle/text/viterbi_decode.py:ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,140 @@
+"""text + audio namespace tests (SURVEY item 36).
+
+viterbi_decode is checked against brute-force path enumeration; audio
+features against scipy.signal / closed-form DSP references.
+"""
+import itertools
+
+import numpy as np
+import pytest
+from scipy import signal as spsignal
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import MFCC, MelSpectrogram, Spectrogram
+from paddle_tpu.audio.functional import (compute_fbank_matrix, create_dct,
+                                         get_window, hz_to_mel, mel_to_hz,
+                                         power_to_db)
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+
+# -- viterbi ------------------------------------------------------------
+def _brute_force(emis, trans, length, bos_eos):
+    n = emis.shape[1]
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(n), repeat=length):
+        s = emis[0, path[0]]
+        if bos_eos:
+            s += trans[-1, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emis[t, path[t]]
+        if bos_eos:
+            s += trans[path[length - 1], -2]
+        if s > best:
+            best, best_path = s, path
+    return best, np.array(best_path)
+
+
+@pytest.mark.parametrize("bos_eos", [False, True])
+def test_viterbi_matches_brute_force(bos_eos):
+    rs = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    emis = rs.uniform(-1, 1, (B, T, N)).astype(np.float32)
+    trans = rs.uniform(-1, 1, (N, N)).astype(np.float32)
+    lengths = np.array([5, 3, 1], np.int64)
+    scores, paths = viterbi_decode(paddle.to_tensor(emis),
+                                   paddle.to_tensor(trans),
+                                   paddle.to_tensor(lengths),
+                                   include_bos_eos_tag=bos_eos)
+    scores = np.asarray(scores._array)
+    paths = np.asarray(paths._array)
+    for b in range(B):
+        want_s, want_p = _brute_force(emis[b], trans, int(lengths[b]),
+                                      bos_eos)
+        np.testing.assert_allclose(scores[b], want_s, rtol=1e-5,
+                                   err_msg=f"batch {b}")
+        np.testing.assert_array_equal(paths[b, :lengths[b]], want_p)
+        assert (paths[b, lengths[b]:] == 0).all()
+
+
+def test_viterbi_decoder_layer_jittable():
+    import jax
+
+    rs = np.random.RandomState(1)
+    emis = rs.uniform(-1, 1, (2, 6, 3)).astype(np.float32)
+    trans = rs.uniform(-1, 1, (3, 3)).astype(np.float32)
+    dec = ViterbiDecoder(paddle.to_tensor(trans),
+                         include_bos_eos_tag=False)
+    s1, p1 = dec(paddle.to_tensor(emis),
+                 paddle.to_tensor(np.array([6, 6], np.int64)))
+
+    from paddle_tpu.text import _viterbi
+
+    jitted = jax.jit(lambda e, t, ln: _viterbi(e, t, ln, False))
+    s2, p2 = jitted(emis, trans, np.array([6, 6]))
+    np.testing.assert_allclose(np.asarray(s1._array), np.asarray(s2),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(p1._array), np.asarray(p2))
+
+
+# -- audio --------------------------------------------------------------
+def test_window_matches_scipy():
+    for name in ("hann", "hamming", "blackman"):
+        got = np.asarray(get_window(name, 64))
+        want = spsignal.get_window(name, 64, fftbins=True)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_mel_scale_roundtrip():
+    f = np.array([0.0, 440.0, 1000.0, 4000.0, 8000.0])
+    np.testing.assert_allclose(np.asarray(mel_to_hz(hz_to_mel(f))), f,
+                               rtol=1e-4, atol=1e-3)
+    # htk closed form
+    np.testing.assert_allclose(float(np.asarray(hz_to_mel(1000.0,
+                                                          htk=True))),
+                               2595.0 * np.log10(1 + 1000 / 700),
+                               rtol=1e-6)
+
+
+def test_spectrogram_matches_scipy_stft():
+    rs = np.random.RandomState(0)
+    x = rs.randn(1, 2048).astype(np.float32)
+    n_fft, hop = 256, 128
+    layer = Spectrogram(n_fft=n_fft, hop_length=hop, window="hann",
+                        power=2.0, center=False)
+    got = np.asarray(layer(paddle.to_tensor(x))._array)[0]
+    _, _, Z = spsignal.stft(x[0], window="hann", nperseg=n_fft,
+                            noverlap=n_fft - hop, boundary=None,
+                            padded=False)
+    want = np.abs(Z * n_fft / 2) ** 2  # undo scipy's win.sum() scaling
+    # scipy scales by 1/win.sum(); hann sum = n_fft/2
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_mel_and_mfcc_shapes_and_dct():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 4096).astype(np.float32)
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40, center=True)
+    m = np.asarray(mel(paddle.to_tensor(x))._array)
+    assert m.shape[0] == 2 and m.shape[1] == 40
+    assert (m >= 0).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)
+    c = np.asarray(mfcc(paddle.to_tensor(x))._array)
+    assert c.shape[:2] == (2, 13)
+    # ortho DCT columns are orthonormal
+    d = np.asarray(create_dct(13, 40))
+    np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+
+def test_power_to_db():
+    s = np.array([1.0, 10.0, 100.0])
+    db = np.asarray(power_to_db(s, top_db=None))
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+    db2 = np.asarray(power_to_db(np.array([1e-9, 100.0]), top_db=80.0))
+    assert db2[0] == pytest.approx(20.0 - 80.0)
+
+
+def test_fbank_rows_nonzero():
+    fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=40))
+    assert fb.shape == (40, 257)
+    assert (fb.sum(axis=1) > 0).all()

@@ -13,20 +13,37 @@ import contextlib
 
 from paddle_tpu.core import dtype as dtypes
 
-# ops that benefit from low precision (MXU ops) — the white list
-WHITE_LIST = {
+# The white list (MXU ops that benefit from low precision) and black
+# list (numerically sensitive, pinned fp32) are AUTHORED in the op
+# schema — ops/ops.yaml `amp:` fields + `amp_extra` for dispatch-only
+# names — and loaded here (the PHI-yaml-is-authoritative design,
+# SURVEY §2 item 6). Fallbacks cover a broken/absent schema file.
+_FALLBACK_WHITE = {
     "matmul", "linear", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
     "mm", "bmm", "einsum", "sdpa", "resnet_stem_s2d",
 }
-
-# numerically sensitive ops that must stay fp32 — the black list
-BLACK_LIST = {
+_FALLBACK_BLACK = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
     "softmax", "log_softmax", "softmax_ce", "softmax_ce_soft", "cross_entropy",
     "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
     "mse_loss", "l1_loss", "bce_loss", "bce_logits", "kl_div", "sum", "mean",
     "norm", "logsumexp", "cumsum",
 }
+
+try:
+    from paddle_tpu.ops import registry as _registry
+
+    WHITE_LIST = set(_registry.amp_white())
+    BLACK_LIST = set(_registry.amp_black())
+except Exception as _e:  # schema unreadable: keep amp functional, LOUDLY
+    import warnings
+
+    warnings.warn(
+        f"ops.yaml schema unreadable ({_e!r}); AMP falling back to "
+        "built-in white/black lists — fix the schema, the fallback may "
+        "lag the authored policy")
+    WHITE_LIST = set(_FALLBACK_WHITE)
+    BLACK_LIST = set(_FALLBACK_BLACK)
 
 _state = {"enabled": False, "dtype": "bfloat16", "level": "O1"}
 

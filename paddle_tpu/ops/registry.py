@@ -1,0 +1,66 @@
+"""Op registry over ops.yaml — the runtime side of the op schema
+(analog of the PHI yaml op system, paddle/phi/api/yaml/ + generator;
+SURVEY §2 item 6). Where the reference generates C++ API/GradNode/
+bindings from yaml, here jax.vjp already provides kernel+VJP and Python
+IS the binding — so the yaml's runtime authority is the parts codegen
+can't subsume: the op inventory (tooling, docs, drift tests) and the
+AMP white/black policy consumed by amp.auto_cast at import.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["all_ops", "get", "search", "amp_white", "amp_black"]
+
+
+@functools.lru_cache(maxsize=1)
+def _load():
+    import yaml
+
+    path = os.path.join(os.path.dirname(__file__), "ops.yaml")
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    return doc
+
+
+@functools.lru_cache(maxsize=1)
+def all_ops():
+    """List of op entries: {op, module, signature, tensor_method, amp}."""
+    return list(_load()["ops"])
+
+
+@functools.lru_cache(maxsize=1)
+def _by_name():
+    return {e["op"]: e for e in all_ops()}
+
+
+def get(name):
+    return _by_name().get(name)
+
+
+def search(pattern):
+    """Substring search over op names: registry.search('conv')."""
+    p = pattern.lower()
+    return [e for e in all_ops() if p in e["op"].lower()]
+
+
+def _amp(category):
+    """Tolerant of hand-edited entries: a missing amp key means 'none',
+    a missing amp_extra section means empty — one malformed entry must
+    not wholesale invalidate the schema."""
+    doc = _load()
+    names = frozenset(e["op"] for e in doc.get("ops", [])
+                      if e.get("amp") == category)
+    extra = doc.get("amp_extra", {}) or {}
+    return names | frozenset(extra.get(category, []) or [])
+
+
+@functools.lru_cache(maxsize=1)
+def amp_white():
+    return _amp("white")
+
+
+@functools.lru_cache(maxsize=1)
+def amp_black():
+    return _amp("black")

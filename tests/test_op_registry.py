@@ -1,0 +1,60 @@
+"""Op-schema tests (SURVEY §2 item 6): ops.yaml is authoritative and
+may not drift from the code — every declared op exists with the declared
+signature and Tensor-method status, the AMP lists come from the schema,
+and every public op is declared.
+"""
+import inspect
+
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import (activation, creation, linalg, manipulation,
+                            math, nn_ops, random_ops, reduction, registry)
+
+MODULES = {
+    "math": math, "creation": creation, "manipulation": manipulation,
+    "reduction": reduction, "linalg": linalg, "activation": activation,
+    "random_ops": random_ops, "nn_ops": nn_ops,
+}
+
+
+def test_every_declared_op_exists_and_matches():
+    assert len(registry.all_ops()) > 250
+    for e in registry.all_ops():
+        mod = MODULES[e["module"]]
+        fn = getattr(mod, e["op"], None)
+        assert callable(fn), f"{e['module']}.{e['op']} missing"
+        if e["signature"] != "(...)":
+            assert str(inspect.signature(fn)) == e["signature"], \
+                f"signature drift for {e['op']}"
+        assert callable(getattr(Tensor, e["op"], None)) == \
+            e["tensor_method"], f"tensor_method drift for {e['op']}"
+
+
+def test_every_public_op_is_declared():
+    declared = {e["op"] for e in registry.all_ops()}
+    for mod_name, mod in MODULES.items():
+        for name in getattr(mod, "__all__", []):
+            assert name in declared, \
+                (f"{mod_name}.{name} is public but absent from ops.yaml —"
+                 " run tools/gen_ops_yaml.py")
+
+
+def test_amp_lists_come_from_schema():
+    from paddle_tpu.amp.auto_cast import BLACK_LIST, WHITE_LIST
+
+    assert WHITE_LIST == set(registry.amp_white())
+    assert BLACK_LIST == set(registry.amp_black())
+    # spot checks: the policy itself
+    assert {"matmul", "conv2d", "resnet_stem_s2d"} <= WHITE_LIST
+    assert {"softmax", "batch_norm", "cross_entropy"} <= BLACK_LIST
+    assert not (WHITE_LIST & BLACK_LIST)
+
+
+def test_registry_lookup_and_search():
+    e = registry.get("conv2d")
+    assert e["module"] == "nn_ops" and e["amp"] == "white"
+    hits = {x["op"] for x in registry.search("conv")}
+    assert {"conv2d", "conv1d", "conv3d", "conv2d_transpose"} <= hits
+    assert registry.get("no_such_op") is None

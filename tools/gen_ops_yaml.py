@@ -1,0 +1,100 @@
+"""Generate paddle_tpu/ops/ops.yaml from the live op surface — the
+op-schema source (analog of paddle/phi/api/yaml/ops.yaml, emitted once
+then maintained by hand alongside new ops).
+
+Each entry records: name, module, signature, whether it is installed as
+a Tensor method, and its AMP category (white = runs bf16 under
+auto_cast, black = pinned fp32, none = follows inputs). The yaml is
+AUTHORITATIVE at runtime for the AMP lists and the op registry
+(paddle_tpu/ops/registry.py); this script only bootstraps/refreshes it.
+
+    python tools/gen_ops_yaml.py        # rewrites ops/ops.yaml
+"""
+import inspect
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import yaml  # noqa: E402
+
+import paddle_tpu  # noqa: E402
+import importlib  # noqa: E402
+
+# the package rebinds the name `auto_cast` to the function; fetch the
+# MODULE from sys.modules via importlib
+ac = importlib.import_module("paddle_tpu.amp.auto_cast")  # noqa: E402
+from paddle_tpu.core.tensor import Tensor  # noqa: E402
+from paddle_tpu.ops import (activation, creation, linalg, manipulation,  # noqa
+                            math, nn_ops, random_ops, reduction)
+
+MODULES = {
+    "math": math, "creation": creation, "manipulation": manipulation,
+    "reduction": reduction, "linalg": linalg, "activation": activation,
+    "random_ops": random_ops, "nn_ops": nn_ops,
+}
+
+
+def main():
+    # the yaml is the policy's source of truth: a refresh PRESERVES the
+    # existing schema's amp fields (new ops default to 'none') instead
+    # of round-tripping through the runtime lists it feeds
+    out = os.path.join(REPO, "paddle_tpu", "ops", "ops.yaml")
+    prev_amp, prev_extra = {}, None
+    if os.path.exists(out):
+        with open(out) as f:
+            prev = yaml.safe_load(f) or {}
+        prev_amp = {e["op"]: e.get("amp", "none")
+                    for e in prev.get("ops", [])}
+        prev_extra = prev.get("amp_extra")
+    white = set(ac.WHITE_LIST)
+    black = set(ac.BLACK_LIST)
+    entries = []
+    for mod_name, mod in MODULES.items():
+        for name in getattr(mod, "__all__", []):
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            try:
+                sig = str(inspect.signature(fn))
+            except (TypeError, ValueError):
+                sig = "(...)"
+            amp = prev_amp.get(name) if name in prev_amp else (
+                "white" if name in white else
+                "black" if name in black else "none")
+            entries.append({
+                "op": name,
+                "module": mod_name,
+                "signature": sig,
+                "tensor_method": callable(getattr(Tensor, name, None)),
+                "amp": amp,
+            })
+    entries.sort(key=lambda e: (e["module"], e["op"]))
+    public = {e["op"] for e in entries}
+    # AMP policies for dispatch-time-only names (fused/internal ops that
+    # aren't public functions: sdpa, mm, the s2d stem, loss internals...)
+    doc = {
+        "ops": entries,
+        "amp_extra": prev_extra if prev_extra is not None else {
+            "white": sorted(white - public),
+            "black": sorted(black - public),
+        },
+    }
+    with open(out, "w") as f:
+        f.write(
+            "# Op schema — analog of paddle/phi/api/yaml/ops.yaml.\n"
+            "# AUTHORITATIVE for the AMP white/black lists and the op\n"
+            "# registry (ops/registry.py loads this at import). Refresh\n"
+            "# with tools/gen_ops_yaml.py after adding ops; the registry\n"
+            "# test fails if code and schema drift.\n")
+        yaml.safe_dump(doc, f, sort_keys=False, width=100)
+    print(f"wrote {len(entries)} ops -> {out}")
+
+
+if __name__ == "__main__":
+    main()

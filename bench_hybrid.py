@@ -94,12 +94,25 @@ def main():
     tok_s = batch * seq / dt
     mfu = tok_s * flops_tok / peak
 
-    print(json.dumps({
-        "metric": "gpt_1p3b_hybrid_mp2_pp2_sharding2_tokens_per_sec",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    if tiny:
+        # degenerate config (n_dev<8 collapses the hybrid degrees, or a
+        # virtual CPU mesh): this validates compile+step only — emitting
+        # a throughput-shaped metric line here would be misleading
+        print(json.dumps({
+            "metric": "gpt_hybrid_compile_check",
+            "value": 1,
+            "unit": "ok (NOT a throughput measurement: tiny/collapsed "
+                    f"config, devices={n_dev} mp={mp} pp={pp} "
+                    f"sharding={sharding})",
+            "vs_baseline": None,
+        }))
+    else:
+        print(json.dumps({
+            "metric": "gpt_1p3b_hybrid_mp2_pp2_sharding2_tokens_per_sec",
+            "value": round(tok_s, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(mfu / 0.45, 4),
+        }))
     print(f"# devices={n_dev} mesh dp={dp} mp={mp} pp={pp} "
           f"sharding={sharding} params={n_params/1e6:.1f}M batch={batch} "
           f"seq={seq} compile={compile_s:.1f}s step={dt*1000:.1f}ms "

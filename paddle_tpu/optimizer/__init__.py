@@ -7,11 +7,12 @@ from .optimizers import (
     Adam,
     AdamW,
     Lamb,
+    Lars,
     Momentum,
     RMSProp,
 )
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
-    "Adadelta", "Lamb", "lr",
+    "Adadelta", "Lamb", "Lars", "lr",
 ]

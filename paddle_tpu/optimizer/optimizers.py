@@ -209,6 +209,50 @@ class Adadelta(Optimizer):
             "avg_squared_grad": asg, "avg_squared_update": asu}
 
 
+class Lars(Optimizer):
+    """LARS — layer-wise adaptive rate scaling for large-batch SGD
+    (paddle/incubate/optimizer LarsMomentumOptimizer;
+    meta_optimizers/lars_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9,
+                 exclude_from_weight_decay=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._epsilon = epsilon
+        # substring match on parameter names (paddle Lars semantics:
+        # e.g. ['bias', 'bn'] skips decay for biases and batch norms)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _create_accumulators(self):
+        return {"velocity": self._zeros_like_params(jnp.float32)}
+
+    def _per_param_extras(self, i):
+        name = getattr(self._parameter_list[i], "name", None) or ""
+        excluded = any(s in name for s in self._exclude)
+        return {"decay": jnp.asarray(0.0 if excluded else self._wd,
+                                     jnp.float32)}
+
+    def _single_update(self, p, g, acc, lr, step, extras=None):
+        wd = extras["decay"] if extras else self._wd
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm /
+            (g_norm + wd * w_norm + self._epsilon),
+            1.0)
+        v = self._momentum * acc["velocity"] + \
+            lr * local_lr * (g + wd * pf)
+        new_p = pf - v
+        return new_p.astype(p.dtype), {"velocity": v}
+
+
 class Lamb(Optimizer):
     """LAMB (paddle/optimizer/lamb.py; meta_optimizers/lamb_optimizer.py)."""
 

@@ -90,6 +90,61 @@ def test_warmup_scheduler():
     np.testing.assert_allclose(vals[4:], [0.1, 0.1])
 
 
+def test_lars_matches_formula():
+    p = _param([3.0, 4.0])  # ||w|| = 5
+    opt = paddle.optimizer.Lars(learning_rate=0.1, momentum=0.9,
+                                lars_coeff=0.001,
+                                lars_weight_decay=0.0005, parameters=[p])
+    p.grad = paddle.to_tensor([0.6, 0.8])  # ||g|| = 1
+    opt.step()
+    w_norm, g_norm = 5.0, 1.0
+    local_lr = 0.001 * w_norm / (g_norm + 0.0005 * w_norm + 1e-9)
+    v = 0.1 * local_lr * (np.array([0.6, 0.8])
+                          + 0.0005 * np.array([3.0, 4.0]))
+    np.testing.assert_allclose(p.numpy(), np.array([3.0, 4.0]) - v,
+                               rtol=1e-6)
+    # second step: hand-compute momentum accumulation
+    w1 = p.numpy().copy()
+    p.grad = paddle.to_tensor([0.6, 0.8])
+    opt.step()
+    g = np.array([0.6, 0.8])
+    w_norm1 = np.linalg.norm(w1)
+    g_norm1 = np.linalg.norm(g)
+    local_lr2 = 0.001 * w_norm1 / (g_norm1 + 0.0005 * w_norm1 + 1e-9)
+    v2 = 0.9 * v + 0.1 * local_lr2 * (g + 0.0005 * w1)
+    np.testing.assert_allclose(p.numpy(), w1 - v2, rtol=1e-5)
+
+
+def test_lars_exclude_from_weight_decay():
+    p = _param([3.0, 4.0])
+    p.name = "layer.bias"
+    opt = paddle.optimizer.Lars(learning_rate=0.1, momentum=0.0,
+                                lars_coeff=0.001, lars_weight_decay=0.5,
+                                exclude_from_weight_decay=["bias"],
+                                parameters=[p])
+    p.grad = paddle.to_tensor([0.6, 0.8])
+    opt.step()
+    # decay excluded -> wd=0 in both local_lr and the update
+    local_lr = 0.001 * 5.0 / (1.0 + 1e-9)
+    want = np.array([3.0, 4.0]) - 0.1 * local_lr * np.array([0.6, 0.8])
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-6)
+
+
+def test_lars_trains_under_trainstep():
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.Lars(learning_rate=0.5, parameters=net.parameters())
+    step = jit.TrainStep(net, opt, F.mse_loss)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(16, 4).astype(np.float32))
+    losses = [float(step(x, y)) for _ in range(20)]
+    assert losses[-1] < losses[0]
+
+
 def test_optimizer_state_dict_roundtrip():
     p = _param([1.0, 2.0])
     opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])

@@ -94,7 +94,8 @@ from paddle_tpu import sparse  # noqa: E402
 from paddle_tpu import quantization  # noqa: E402
 from paddle_tpu import text  # noqa: E402
 from paddle_tpu import audio  # noqa: E402
-from paddle_tpu.hapi import Model  # noqa: E402
+from paddle_tpu.hapi import Model, summary  # noqa: E402
+from paddle_tpu import static  # noqa: E402
 from paddle_tpu.hapi import callbacks  # noqa: E402
 
 # paddle-style helpers

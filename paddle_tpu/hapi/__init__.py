@@ -2,5 +2,6 @@
 fit/evaluate/predict/save/load plus the callback set."""
 from . import callbacks
 from .model import Model
+from .summary import summary
 
-__all__ = ["Model", "callbacks"]
+__all__ = ["Model", "callbacks", "summary"]

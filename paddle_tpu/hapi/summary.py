@@ -1,0 +1,92 @@
+"""paddle.summary analog (python/paddle/hapi/model_summary.py): layer
+table with output shapes and parameter counts, collected via forward
+post-hooks on one zero-input forward pass."""
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Prints the table; returns {'total_params': .., 'trainable_params': ..}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, output):
+            outs = output if isinstance(output, (tuple, list)) else [output]
+            shapes = [list(o.shape) for o in outs
+                      if isinstance(o, Tensor)]
+            n_params = int(sum(np.prod(p.shape)
+                               for p in lyr.parameters(include_sublayers=False))) \
+                if hasattr(lyr, "parameters") else 0
+            rows.append((name, type(lyr).__name__,
+                         shapes[0] if shapes else [], n_params))
+        return hook
+
+    named = list(net.named_sublayers()) if hasattr(net, "named_sublayers") \
+        else []
+    for name, layer in named:
+        if hasattr(layer, "register_forward_post_hook"):
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+
+    try:
+        if input is not None:
+            net(input)
+        else:
+            if input_size is None:
+                raise ValueError(
+                    "summary needs input_size (a shape, list of shapes, "
+                    "or InputSpecs) or a concrete `input` tensor")
+            from paddle_tpu.jit.api import InputSpec
+
+            def norm(item):
+                """shape tuple / InputSpec -> (concrete shape, dtype)."""
+                if isinstance(item, InputSpec):
+                    shape, dt = item.shape, item.dtype or "float32"
+                else:
+                    shape, dt = item, None
+                # None/-1/named dims (unspecified batch) -> 1, paddle-style
+                shape = [1 if d is None or isinstance(d, str)
+                         or (isinstance(d, int) and d < 0)
+                         else int(d) for d in shape]
+                return shape, dt
+
+            first = input_size[0]
+            items = list(input_size) if isinstance(
+                first, (list, tuple, InputSpec)) else [input_size]
+            if dtypes is not None and len(dtypes) != len(items):
+                raise ValueError(
+                    f"dtypes has {len(dtypes)} entries for {len(items)} "
+                    "inputs")
+            args = []
+            for i, item in enumerate(items):
+                shape, spec_dt = norm(item)
+                dt = (dtypes[i] if dtypes is not None
+                      else spec_dt or "float32")
+                args.append(Tensor(np.zeros(shape, np.dtype(dt))))
+            net(*args)
+    finally:
+        for h in hooks:
+            h.remove()
+
+    total = int(sum(np.prod(p.shape) for p in net.parameters()))
+    trainable = int(sum(np.prod(p.shape) for p in net.parameters()
+                        if not p.stop_gradient))
+    w = max([len(r[0]) + len(r[1]) for r in rows] + [20]) + 4
+    line = "-" * (w + 40)
+    print(line)
+    print(f"{'Layer (type)':<{w}}{'Output Shape':<22}{'Param #':>12}")
+    print(line)
+    for name, cls, shape, n in rows:
+        print(f"{name + ' (' + cls + ')':<{w}}{str(shape):<22}{n:>12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

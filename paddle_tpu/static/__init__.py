@@ -1,0 +1,30 @@
+"""paddle.static analog — the subset that survives the TPU-native
+design.
+
+The reference's static-graph stack (Program/Executor/feed-fetch,
+python/paddle/static/) exists because its eager mode couldn't compile;
+here EVERY compiled path goes through jit.to_static/TrainStep, so the
+Program surface is deliberately absent. What remains meaningful:
+InputSpec (the AOT signature contract — shared with jit), and
+device_guard/name_scope as no-op context managers for source
+compatibility (placement is mesh-driven; naming is for humans).
+"""
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu.jit.api import InputSpec
+
+__all__ = ["InputSpec", "device_guard", "name_scope"]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """No-op: placement is controlled by the mesh/shardings, not
+    per-op guards. Kept so reference code imports run."""
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield

@@ -160,6 +160,44 @@ def test_model_checkpoint_and_early_stopping(tmp_path):
     assert not os.path.exists(os.path.join(save_dir, "4.pdparams"))
 
 
+def test_summary_counts_params(capsys):
+    net = _MnistNet()
+    info = paddle.summary(net, (2, 28, 28, 1))
+    want = 784 * 64 + 64 + 64 * 10 + 10
+    assert info["total_params"] == want
+    assert info["trainable_params"] == want
+    out = capsys.readouterr().out
+    assert "fc1 (Linear)" in out and "Total params" in out
+    assert f"{want:,}" in out
+
+
+def test_summary_arg_forms():
+    import pytest as _pytest
+
+    net = _MnistNet()
+    want = 784 * 64 + 64 + 64 * 10 + 10
+    # None batch dim (paddle idiom) and InputSpec both work
+    assert paddle.summary(net, (None, 28, 28, 1))["total_params"] == want
+    from paddle_tpu.static import InputSpec
+
+    assert paddle.summary(
+        net, [InputSpec([-1, 28, 28, 1], "float32")])["total_params"] \
+        == want
+    with _pytest.raises(ValueError, match="input_size"):
+        paddle.summary(net)
+    with _pytest.raises(ValueError, match="dtypes"):
+        paddle.summary(net, [(2, 28, 28, 1)], dtypes=["float32", "int64"])
+
+
+def test_static_namespace():
+    from paddle_tpu.static import InputSpec, device_guard, name_scope
+
+    s = InputSpec([None, 4], "float32")
+    assert s.shape == (None, 4)
+    with device_guard("gpu:0"), name_scope("blk"):
+        pass  # source-compat no-ops
+
+
 def test_lr_scheduler_steps_in_fit(tmp_path):
     ip, lp, _, _ = _write_mnist(str(tmp_path), n=64)
     ds = MNIST(image_path=ip, label_path=lp)

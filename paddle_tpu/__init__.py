@@ -96,6 +96,7 @@ from paddle_tpu import text  # noqa: E402
 from paddle_tpu import audio  # noqa: E402
 from paddle_tpu.hapi import Model, summary  # noqa: E402
 from paddle_tpu import static  # noqa: E402
+from paddle_tpu import incubate  # noqa: E402
 from paddle_tpu.hapi import callbacks  # noqa: E402
 
 # paddle-style helpers

@@ -56,9 +56,12 @@ def summary(net, input_size=None, dtypes=None, input=None):
                          else int(d) for d in shape]
                 return shape, dt
 
-            first = input_size[0]
-            items = list(input_size) if isinstance(
-                first, (list, tuple, InputSpec)) else [input_size]
+            if isinstance(input_size, InputSpec):
+                items = [input_size]
+            else:
+                first = input_size[0]
+                items = list(input_size) if isinstance(
+                    first, (list, tuple, InputSpec)) else [input_size]
             if dtypes is not None and len(dtypes) != len(items):
                 raise ValueError(
                     f"dtypes has {len(dtypes)} entries for {len(items)} "

@@ -1,0 +1,13 @@
+"""paddle.incubate analog — stable aliases for features the reference
+ships under incubate (python/paddle/incubate/): the MoE layer
+(incubate/distributed/models/moe/) and fused transformer functionality
+live in their first-class homes here; incubate re-exports them for
+import-path parity.
+"""
+from paddle_tpu.distributed.moe import MoELayer, switch_gating, top2_gating
+from paddle_tpu.nn import TransformerEncoderLayer as FusedTransformerLayer
+
+from . import distributed
+
+__all__ = ["MoELayer", "top2_gating", "switch_gating",
+           "FusedTransformerLayer", "distributed"]

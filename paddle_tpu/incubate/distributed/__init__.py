@@ -1,0 +1,5 @@
+"""incubate.distributed path parity: models.moe lives at
+paddle_tpu.distributed.moe (first-class)."""
+from . import models
+
+__all__ = ["models"]

@@ -1,0 +1,6 @@
+"""Alias of paddle_tpu.distributed.moe (reference path:
+python/paddle/incubate/distributed/models/moe/moe_layer.py)."""
+from paddle_tpu.distributed.moe import (MoELayer, switch_gating,
+                                        top2_gating)
+
+__all__ = ["MoELayer", "top2_gating", "switch_gating"]

@@ -183,6 +183,12 @@ def test_summary_arg_forms():
     assert paddle.summary(
         net, [InputSpec([-1, 28, 28, 1], "float32")])["total_params"] \
         == want
+    # bare InputSpec form
+    assert paddle.summary(
+        net, InputSpec([None, 28, 28, 1], "float32"))["total_params"] \
+        == want
+    # incubate path parity reachable from the root package
+    assert paddle.incubate.MoELayer is not None
     with _pytest.raises(ValueError, match="input_size"):
         paddle.summary(net)
     with _pytest.raises(ValueError, match="dtypes"):

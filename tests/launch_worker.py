@@ -124,6 +124,87 @@ def run_ps(dist, paddle, rank, world):
     print("ok ps", flush=True)
 
 
+def run_zero(dist, paddle, rank, world, out_file):
+    """ZeRO-2 with the 'sharding' axis spanning PROCESS boundaries: each
+    rank holds one device, so the reduce-scatter/all-gather the SPMD
+    partitioner inserts ride the cross-process fabric — the
+    group_sharded multi-host regime."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+
+    hcg = HybridCommunicateGroup(sharding=world)
+    set_hybrid_communicate_group(hcg)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 16))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    from paddle_tpu.distributed import make_sharded_step
+
+    step = make_sharded_step(net, opt, lambda o, t: F.mse_loss(o, t),
+                             level="os_g")
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(4):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        losses.append(float(step(paddle.to_tensor(x),
+                                 paddle.to_tensor(y))))
+    # opt state is genuinely sharded across the two processes
+    m = opt._accumulators["moment1"][0]
+    assert "sharding" in str(m.sharding.spec), m.sharding
+    if rank == 0 and out_file:
+        with open(out_file, "w") as f:
+            json.dump(losses, f)
+    print("ok zero", losses, flush=True)
+
+
+def run_mp(dist, paddle, rank, world, out_file):
+    """Tensor parallel with the 'mp' axis spanning processes: the row
+    layer's partial-sum all-reduce crosses the process fabric (the
+    multi-host Megatron regime)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import (DistributedTrainStep,
+                                        HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+
+    hcg = HybridCommunicateGroup(mp=world)
+    set_hybrid_communicate_group(hcg)
+    paddle.seed(0)
+    import paddle_tpu.nn as nn
+
+    class MPNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = dist.ColumnParallelLinear(16, 32,
+                                                 gather_output=False)
+            self.row = dist.RowParallelLinear(32, 16,
+                                              input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(self.col(x))
+
+    net = MPNet()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    step = DistributedTrainStep(net, opt, lambda o, t: F.mse_loss(o, t),
+                                hcg=hcg, batch_axes=())
+    rng = np.random.RandomState(11)
+    losses = []
+    for _ in range(4):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        losses.append(float(step(paddle.to_tensor(x),
+                                 paddle.to_tensor(y))))
+    w = net.col.weight._array
+    assert "mp" in str(w.sharding.spec), w.sharding
+    if rank == 0 and out_file:
+        with open(out_file, "w") as f:
+            json.dump(losses, f)
+    print("ok mp", losses, flush=True)
+
+
 def _remote_square(x):
     return x * x
 
@@ -171,14 +252,23 @@ def main():
     assert world == int(os.environ["PADDLE_TRAINERS_NUM"]), \
         f"world={world} env={os.environ['PADDLE_TRAINERS_NUM']}"
 
+    # out_file goes only to the explicitly requested phase: under
+    # phase 'all' the writers would silently overwrite each other
     if phase in ("all", "collectives"):
         run_collectives(dist, paddle, rank, world)
     if phase in ("all", "train"):
-        run_train(dist, paddle, rank, world, out_file)
+        run_train(dist, paddle, rank, world,
+                  out_file if phase == "train" else None)
     if phase in ("all", "ps"):
         run_ps(dist, paddle, rank, world)
     if phase in ("all", "rpc"):
         run_rpc(dist, paddle, rank, world)
+    if phase in ("all", "zero"):
+        run_zero(dist, paddle, rank, world,
+                 out_file if phase == "zero" else None)
+    if phase in ("all", "mp"):
+        run_mp(dist, paddle, rank, world,
+               out_file if phase == "mp" else None)
     print("WORKER_DONE", flush=True)
 
 

@@ -46,6 +46,80 @@ def test_two_process_ps_pull_push():
     assert res.stdout.count("ok ps\n") == 2
 
 
+def test_two_process_zero_sharding_parity(tmp_path):
+    """ZeRO-2 across process boundaries matches a single-process
+    baseline on the same global batches (multi-host group_sharded)."""
+    out_file = str(tmp_path / "zero_losses.json")
+    res = _launch("zero", out_file)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    with open(out_file) as f:
+        dist_losses = json.load(f)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.jit as jit
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.Tanh(), nn.Linear(64, 16))
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    step = jit.TrainStep(net, opt, F.mse_loss)
+    rng = np.random.RandomState(7)
+    base = []
+    for _ in range(4):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        base.append(float(step(paddle.to_tensor(x), paddle.to_tensor(y))))
+    np.testing.assert_allclose(dist_losses, base, rtol=1e-4, atol=1e-6)
+
+
+def test_two_process_tensor_parallel_parity(tmp_path):
+    """mp=2 across processes (cross-process partial-sum all-reduce)
+    matches a replicated single-process run."""
+    out_file = str(tmp_path / "mp_losses.json")
+    res = _launch("mp", out_file)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    with open(out_file) as f:
+        dist_losses = json.load(f)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.jit as jit
+    from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+
+    set_hybrid_communicate_group(HybridCommunicateGroup())  # degree 1
+    paddle.seed(0)
+
+    class MPNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = dist.ColumnParallelLinear(16, 32,
+                                                 gather_output=False)
+            self.row = dist.RowParallelLinear(32, 16,
+                                              input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(self.col(x))
+
+    net = MPNet()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    step = jit.TrainStep(net, opt, F.mse_loss)
+    rng = np.random.RandomState(11)
+    base = []
+    for _ in range(4):
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        base.append(float(step(paddle.to_tensor(x), paddle.to_tensor(y))))
+    np.testing.assert_allclose(dist_losses, base, rtol=1e-4, atol=1e-6)
+
+
 def test_two_process_train_parity(tmp_path):
     out_file = str(tmp_path / "losses.json")
     res = _launch("train", out_file)

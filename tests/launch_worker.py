@@ -205,6 +205,27 @@ def run_mp(dist, paddle, rank, world, out_file):
     print("ok mp", losses, flush=True)
 
 
+def run_pp(dist, paddle, rank, world, out_file):
+    """Pipeline parallel with the 'pp' axis spanning processes: the
+    shift-register's collective-permute crosses the process fabric (the
+    multi-host p2p send/recv regime)."""
+    from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+    from pp_model import build_pp_model, run_pp_losses
+
+    set_hybrid_communicate_group(HybridCommunicateGroup(pp=world))
+    model, step = build_pp_model(num_stages=world)
+    losses = run_pp_losses(step, paddle)
+    # the stacked body must REALLY be pp-sharded — a silent fallback to
+    # replicated sequential execution would still match the baseline
+    stacked = model.stack._stacked[0]._array
+    assert "pp" in str(stacked.sharding.spec), stacked.sharding
+    if rank == 0 and out_file:
+        with open(out_file, "w") as f:
+            json.dump(losses, f)
+    print("ok pp", losses, flush=True)
+
+
 def _remote_square(x):
     return x * x
 
@@ -269,6 +290,9 @@ def main():
     if phase in ("all", "mp"):
         run_mp(dist, paddle, rank, world,
                out_file if phase == "mp" else None)
+    if phase in ("all", "pp"):
+        run_pp(dist, paddle, rank, world,
+               out_file if phase == "pp" else None)
     print("WORKER_DONE", flush=True)
 
 

@@ -1,0 +1,61 @@
+"""Shared pipeline-model builder for the cross-process pp parity test:
+the worker (launch_worker.run_pp) and the in-process baseline
+(test_multiprocess.test_two_process_pipeline_parity) must construct
+byte-identical models, so the definition lives once, importable by both
+(tests/ is on the worker's sys.path)."""
+import numpy as np
+
+
+def build_pp_model(num_stages, seed=3):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed import (DistributedTrainStep, LayerDesc,
+                                        PipelineLayer)
+
+    class Block(nn.Layer):
+        def __init__(self, hidden):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x)) + x
+
+    class Embed(nn.Layer):
+        def __init__(self, vocab, hidden):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, hidden)
+
+        def forward(self, ids):
+            return self.emb(ids)
+
+    class Head(nn.Layer):
+        def __init__(self, hidden, vocab):
+            super().__init__()
+            self.proj = nn.Linear(hidden, vocab)
+
+        def forward(self, x):
+            return self.proj(x)
+
+    paddle.seed(seed)
+    model = PipelineLayer(
+        [LayerDesc(Embed, 64, 16),
+         *[LayerDesc(Block, 16) for _ in range(4)],
+         LayerDesc(Head, 16, 64)],
+        num_stages=num_stages, num_microbatches=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = DistributedTrainStep(
+        model, opt,
+        lambda out, lab: F.cross_entropy(
+            out.reshape([-1, 64]), lab.reshape([-1])))
+    return model, step
+
+
+def run_pp_losses(step, paddle, steps=4):
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(steps):
+        ids = paddle.to_tensor(rng.randint(0, 64, (8, 12), np.int32))
+        losses.append(float(step(ids, ids)))
+    return losses

@@ -120,6 +120,28 @@ def test_two_process_tensor_parallel_parity(tmp_path):
     np.testing.assert_allclose(dist_losses, base, rtol=1e-4, atol=1e-6)
 
 
+def test_two_process_pipeline_parity(tmp_path):
+    """pp=2 across processes (shift-register collective-permute over the
+    process fabric) matches the same model at pp=1."""
+    out_file = str(tmp_path / "pp_losses.json")
+    res = _launch("pp", out_file)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    with open(out_file) as f:
+        dist_losses = json.load(f)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+    from tests.pp_model import build_pp_model, run_pp_losses
+
+    set_hybrid_communicate_group(HybridCommunicateGroup(pp=1))
+    _, step = build_pp_model(num_stages=1)
+    base = run_pp_losses(step, paddle)
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+    np.testing.assert_allclose(dist_losses, base, rtol=1e-3, atol=1e-5)
+
+
 def test_two_process_train_parity(tmp_path):
     out_file = str(tmp_path / "losses.json")
     res = _launch("train", out_file)

@@ -226,6 +226,56 @@ def run_pp(dist, paddle, rank, world, out_file):
     print("ok pp", losses, flush=True)
 
 
+def run_epcp(dist, paddle, rank, world, out_file):
+    """Expert parallel (MoE token all-to-all) and context parallel (ring
+    attention ppermute) with their axes spanning processes."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+    from paddle_tpu.distributed.ring_attention import ring_attention
+
+    # ep: tokens ship to their expert's owner process and back
+    set_hybrid_communicate_group(HybridCommunicateGroup(ep=world))
+    paddle.seed(0)
+    moe = dist.MoELayer(d_model=8, d_hidden=16, num_experts=4,
+                        capacity_factor=4.0)
+    x_np = np.random.RandomState(0).randn(2, 8, 8).astype(np.float32)
+    y = moe(paddle.to_tensor(x_np))
+    from jax.experimental import multihost_utils
+
+    # the output shards span both processes; gather to host-local numpy
+    y_np = np.asarray(multihost_utils.process_allgather(y._array))
+
+    # cp: ring attention over a cross-process sequence shard
+    hcg = HybridCommunicateGroup(cp=world)
+    set_hybrid_communicate_group(hcg)
+    mesh = hcg.mesh
+    B, S, H, D = 1, 8, 2, 4
+    rs = np.random.RandomState(1)
+    q = rs.randn(B, S, H, D).astype(np.float32)
+    k = rs.randn(B, S, H, D).astype(np.float32)
+    v = rs.randn(B, S, H, D).astype(np.float32)
+    fn = shard_map(
+        lambda a, b, c: ring_attention(a, b, c, axis_name="cp",
+                                       causal=True),
+        mesh=mesh, in_specs=(P(None, "cp"),) * 3, out_specs=P(None, "cp"))
+    out = jax.jit(fn)(q, k, v)
+    # each process holds its own sequence shard
+    sh = out.addressable_shards[0]
+    local = np.asarray(sh.data)
+    seq_slice = sh.index[1]
+
+    if rank == 0 and out_file:
+        with open(out_file, "w") as f:
+            json.dump({"moe_out": y_np.tolist(),
+                       "cp_local": local.tolist(),
+                       "cp_start": int(seq_slice.start or 0)}, f)
+    print("ok epcp", flush=True)
+
+
 def _remote_square(x):
     return x * x
 
@@ -293,6 +343,9 @@ def main():
     if phase in ("all", "pp"):
         run_pp(dist, paddle, rank, world,
                out_file if phase == "pp" else None)
+    if phase in ("all", "epcp"):
+        run_epcp(dist, paddle, rank, world,
+                 out_file if phase == "epcp" else None)
     print("WORKER_DONE", flush=True)
 
 

@@ -142,6 +142,48 @@ def test_two_process_pipeline_parity(tmp_path):
     np.testing.assert_allclose(dist_losses, base, rtol=1e-3, atol=1e-5)
 
 
+def test_two_process_ep_and_cp_parity(tmp_path):
+    """MoE expert-parallel forward and ring-attention context parallel
+    with their axes across processes match single-process references."""
+    out_file = str(tmp_path / "epcp.json")
+    res = _launch("epcp", out_file)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    with open(out_file) as f:
+        got = json.load(f)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import (HybridCommunicateGroup,
+                                        set_hybrid_communicate_group)
+
+    # ep baseline: same seed/weights at ep degree 1
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+    paddle.seed(0)
+    moe = dist.MoELayer(d_model=8, d_hidden=16, num_experts=4,
+                        capacity_factor=4.0)
+    x_np = np.random.RandomState(0).randn(2, 8, 8).astype(np.float32)
+    want_moe = np.asarray(moe(paddle.to_tensor(x_np))._array)
+    np.testing.assert_allclose(np.asarray(got["moe_out"], np.float32),
+                               want_moe, rtol=1e-4, atol=1e-5)
+
+    # cp baseline: dense causal attention; compare rank 0's seq shard
+    from paddle_tpu.ops import nn_ops
+
+    B, S, H, D = 1, 8, 2, 4
+    rs = np.random.RandomState(1)
+    q = rs.randn(B, S, H, D).astype(np.float32)
+    k = rs.randn(B, S, H, D).astype(np.float32)
+    v = rs.randn(B, S, H, D).astype(np.float32)
+    dense = np.asarray(nn_ops.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True, dropout_p=0.0)._array)
+    local = np.asarray(got["cp_local"], np.float32)
+    s0 = got["cp_start"]
+    np.testing.assert_allclose(
+        local, dense[:, s0:s0 + local.shape[1]], rtol=1e-4, atol=1e-5)
+
+
 def test_two_process_train_parity(tmp_path):
     out_file = str(tmp_path / "losses.json")
     res = _launch("train", out_file)

@@ -247,7 +247,10 @@ def run_epcp(dist, paddle, rank, world, out_file):
     from jax.experimental import multihost_utils
 
     # the output shards span both processes; gather to host-local numpy
-    y_np = np.asarray(multihost_utils.process_allgather(y._array))
+    # (jax REQUIRES tiled=True for global non-fully-addressable arrays —
+    # it reassembles the global value rather than stacking copies)
+    y_np = np.asarray(multihost_utils.process_allgather(y._array,
+                                                        tiled=True))
 
     # cp: ring attention over a cross-process sequence shard
     hcg = HybridCommunicateGroup(cp=world)

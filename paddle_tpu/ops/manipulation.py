@@ -33,7 +33,15 @@ def reshape(x, shape):
     x = as_tensor(x)
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    shape = tuple(int(s) for s in shape)
+    def dim(s):
+        # coerce ints/0-d Tensors/floats; symbolic dims (jax.export
+        # shape polymorphism) raise on int() and pass through untouched
+        try:
+            return int(s)
+        except Exception:  # TypeError, or jax's
+            return s       # InconclusiveDimensionOperation for symbols
+
+    shape = tuple(dim(s) for s in shape)
     return apply("reshape", lambda a: jnp.reshape(a, shape), x)
 
 

@@ -160,6 +160,31 @@ def test_model_checkpoint_and_early_stopping(tmp_path):
     assert not os.path.exists(os.path.join(save_dir, "4.pdparams"))
 
 
+def test_model_save_inference_then_load_predictor(tmp_path):
+    """Model.save(training=False) -> jit predictor parity (the deploy
+    handoff: fit with hapi, serve without the Python class)."""
+    from paddle_tpu.jit.api import InputSpec
+
+    model, ds = _fit_model(tmp_path, epochs=1)
+    model._inputs = [InputSpec([None, 28, 28, 1], "float32")]
+    path = str(tmp_path / "deploy" / "m")
+    model.save(path, training=False)
+
+    import paddle_tpu.jit as jit
+
+    pred = jit.load(path)
+    x = np.asarray(ds[0][0])[None]
+    want = model.predict_batch([paddle.to_tensor(x)])
+    want = np.asarray(want[0] if isinstance(want, (list, tuple)) else want)
+    got = pred(x)
+    got = np.asarray(got[0] if isinstance(got, (list, tuple)) else got)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # dynamic batch honored by the exported program
+    got3 = pred(np.repeat(x, 3, axis=0))
+    got3 = np.asarray(got3[0] if isinstance(got3, (list, tuple)) else got3)
+    assert got3.shape[0] == 3
+
+
 def test_summary_counts_params(capsys):
     net = _MnistNet()
     info = paddle.summary(net, (2, 28, 28, 1))

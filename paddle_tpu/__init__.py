@@ -97,6 +97,8 @@ from paddle_tpu import audio  # noqa: E402
 from paddle_tpu.hapi import Model, summary  # noqa: E402
 from paddle_tpu import static  # noqa: E402
 from paddle_tpu import incubate  # noqa: E402
+from paddle_tpu import linalg  # noqa: E402
+from paddle_tpu import fft  # noqa: E402
 from paddle_tpu.hapi import callbacks  # noqa: E402
 
 # paddle-style helpers

@@ -1,0 +1,68 @@
+"""paddle.fft analog (python/paddle/fft.py): FFT family over jnp.fft,
+dispatched through the op layer so transforms are differentiable on the
+tape and fuse under jit (TPU lowers FFTs natively)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.dispatch import apply, as_tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "rfft2",
+           "irfft2", "fftn", "ifftn", "fftshift", "ifftshift",
+           "fftfreq", "rfftfreq", "hfft", "ihfft"]
+
+
+def _mk(name, jfn, takes_n=True):
+    if takes_n:
+        def op(x, n=None, axis=-1, norm="backward", name=None):
+            return apply(name, lambda a: jfn(a, n=n, axis=axis, norm=norm),
+                         as_tensor(x))
+    else:
+        def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+            return apply(name, lambda a: jfn(a, s=s, axes=axes, norm=norm),
+                         as_tensor(x))
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+fft2 = _mk("fft2", jnp.fft.fft2, takes_n=False)
+ifft2 = _mk("ifft2", jnp.fft.ifft2, takes_n=False)
+rfft2 = _mk("rfft2", jnp.fft.rfft2, takes_n=False)
+irfft2 = _mk("irfft2", jnp.fft.irfft2, takes_n=False)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("fftn", lambda a: jnp.fft.fftn(a, s=s, axes=axes,
+                                                norm=norm), as_tensor(x))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return apply("ifftn", lambda a: jnp.fft.ifftn(a, s=s, axes=axes,
+                                                  norm=norm), as_tensor(x))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                 as_tensor(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                 as_tensor(x))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    return Tensor._wrap(out.astype(dtype) if dtype is not None else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    return Tensor._wrap(out.astype(dtype) if dtype is not None else out)

@@ -1,0 +1,13 @@
+"""paddle.linalg namespace (python/paddle/linalg.py does the same
+re-export dance over tensor.linalg): the implementations live in
+ops/linalg.py and dispatch through the op layer."""
+from paddle_tpu.ops.linalg import (bmm, cholesky, cross, det, dist, dot,
+                                   eigh, inner, inverse, kron, matmul,
+                                   matrix_power, mm, mv, norm, outer, pinv,
+                                   qr, slogdet, solve, svd, t, trace,
+                                   triangular_solve)
+
+__all__ = ["matmul", "mm", "bmm", "dot", "outer", "inner", "t", "norm",
+           "dist", "cross", "cholesky", "inverse", "pinv", "solve",
+           "triangular_solve", "svd", "qr", "eigh", "det", "slogdet",
+           "matrix_power", "trace", "kron", "mv"]

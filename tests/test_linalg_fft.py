@@ -1,0 +1,55 @@
+"""paddle.linalg / paddle.fft namespace tests: numerics vs numpy and
+gradient flow through the op layer.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_linalg_namespace():
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 4).astype(np.float32)
+    m = a @ a.T + 4 * np.eye(4, dtype=np.float32)  # SPD
+    t = paddle.to_tensor(m)
+
+    np.testing.assert_allclose(np.asarray(paddle.linalg.inverse(t)._array),
+                               np.linalg.inv(m), rtol=1e-4, atol=1e-5)
+    L = np.asarray(paddle.linalg.cholesky(t)._array)
+    np.testing.assert_allclose(L @ L.T, m, rtol=1e-4, atol=1e-4)
+    u, s, vh = paddle.linalg.svd(t)
+    np.testing.assert_allclose(np.sort(np.asarray(s._array))[::-1],
+                               np.sort(np.linalg.svd(m)[1])[::-1],
+                               rtol=1e-4)
+    sign, logdet = paddle.linalg.slogdet(t)
+    np.testing.assert_allclose(float(sign._array)
+                               * np.exp(float(logdet._array)),
+                               np.linalg.det(m), rtol=1e-3)
+
+
+def test_fft_roundtrip_and_reference():
+    rs = np.random.RandomState(1)
+    x = rs.randn(64).astype(np.float32)
+    F = np.asarray(paddle.fft.rfft(paddle.to_tensor(x))._array)
+    np.testing.assert_allclose(F, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    back = np.asarray(paddle.fft.irfft(
+        paddle.to_tensor(F), n=64)._array)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+    # 2-D + shift + freqs
+    img = rs.randn(8, 8).astype(np.float32)
+    F2 = np.asarray(paddle.fft.fft2(paddle.to_tensor(img))._array)
+    np.testing.assert_allclose(F2, np.fft.fft2(img), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.fftfreq(8, d=0.5)._array),
+        np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+
+
+def test_fft_gradient_flows():
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(32).astype(np.float32))
+    x.stop_gradient = False
+    spec = paddle.fft.rfft(x)
+    power = (spec.abs() ** 2).sum()
+    power.backward()
+    assert x.grad is not None
+    # Parseval: d/dx sum|rfft(x)|^2 relates to x linearly; check nonzero
+    assert float(np.abs(np.asarray(x.grad._array)).sum()) > 0

@@ -78,6 +78,24 @@ def default_jax_device():
     return get_place().jax_device()
 
 
+_supports_complex = None
+
+
+def supports_complex() -> bool:
+    """Whether the default backend can hold complex buffers. Production
+    CPU/GPU/TPU XLA can; the experimental axon tunnel (remote-compile
+    dev TPU) cannot — and a failed op permanently wedges its process, so
+    detection is by platform config (side-effect-free), not probing."""
+    global _supports_complex
+    if _supports_complex is None:
+        import os
+
+        platforms = str(getattr(jax.config, "jax_platforms", None) or
+                        os.environ.get("JAX_PLATFORMS", "") or "")
+        _supports_complex = "axon" not in platforms.lower()
+    return _supports_complex
+
+
 def is_compiled_with_cuda() -> bool:  # API parity; this build has zero CUDA
     return False
 

@@ -7,8 +7,8 @@ paddle-parity eager API is kept as a thin façade.
 """
 from jax.sharding import PartitionSpec
 
-from . import (fleet, functional, moe, mp_layers, pipeline, ps,
-               ring_attention, rpc, sharding)
+from . import (auto_parallel, fleet, functional, moe, mp_layers, pipeline,
+               ps, ring_attention, rpc, sharding)
 from .spawn import spawn
 from .pipeline import (
     LayerDesc,

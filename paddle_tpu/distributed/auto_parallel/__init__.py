@@ -1,0 +1,20 @@
+"""paddle.distributed.auto_parallel — semi-automatic SPMD.
+
+Reference: python/paddle/distributed/auto_parallel/ (35.6k LoC). On TPU
+the completion/partitioner/reshard machinery is XLA SPMD; what remains
+user-facing is the mesh/annotation API (sharding_api), the Strategy
+config, and the Engine trainer.
+"""
+from ..sharding_api import (
+    ProcessMesh,
+    get_mesh,
+    shard_tensor,
+    with_sharding_constraint,
+)
+from .engine import Engine
+from .strategy import Strategy
+
+__all__ = [
+    "Engine", "Strategy", "ProcessMesh", "shard_tensor",
+    "with_sharding_constraint", "get_mesh",
+]

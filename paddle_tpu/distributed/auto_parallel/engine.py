@@ -1,0 +1,389 @@
+"""Auto-parallel Engine — the high-level semi-automatic SPMD trainer,
+analog of python/paddle/distributed/auto_parallel/engine.py:57 (fit
+:812, evaluate :982, predict :1092, cost :1698, save/load :1563/:1646).
+
+TPU-native design: the reference's completion (dist-attr propagation
+over the graph), partitioner (per-rank program split) and reshard
+(send/recv insertion) — ~10k LoC — are all subsumed by XLA SPMD: the
+Engine picks a mesh and per-param PartitionSpecs (the "plan"), builds
+ONE DistributedTrainStep, and lets the compiler propagate shardings and
+insert collectives. The cost model is XLA's own (lowered-module
+cost_analysis), not a hand-built estimator; the tuner compares compiled
+costs of candidate plans.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+from ..spmd import DistributedTrainStep
+from ..topology import (
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from .strategy import Strategy
+
+__all__ = ["Engine"]
+
+
+def _np(x):
+    return np.asarray(x._array if isinstance(x, Tensor) else x)
+
+
+def _to_loader(data, batch_size, shuffle, num_workers=0, drop_last=True):
+    from paddle_tpu.io import DataLoader, Dataset, IterableDataset
+
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if isinstance(data, (Dataset, IterableDataset)):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+    return data
+
+
+def _split_batch(batch):
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        *ins, label = batch
+        return tuple(ins), label
+    return (batch,), None
+
+
+class Engine:
+    """Usage (reference parity, engine.py:57):
+        import paddle_tpu.distributed.auto_parallel as auto
+        strategy = auto.Strategy(); strategy.sharding.enable = True
+        engine = auto.Engine(model, loss, optimizer, metrics, strategy=strategy)
+        engine.fit(train_dataset, epochs=2, batch_size=64)
+        engine.evaluate(valid_dataset)
+        engine.predict(test_dataset)
+        engine.cost()         # XLA cost analysis of the planned step
+        engine.save/load
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy: Optional[Strategy] = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        metrics = metrics or []
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) \
+            else [metrics]
+        self.strategy = strategy or Strategy()
+        self._hcg = None
+        self._step = None
+        self._eval_jit = None
+        self._mode = "train"
+        self.history = None
+        self._prepared_amp = False
+
+    # -- planning ----------------------------------------------------------
+    def _ensure_hcg(self) -> HybridCommunicateGroup:
+        """The plan's mesh: an explicitly set HybridCommunicateGroup wins
+        (semi-automatic mode — the user annotated a topology); otherwise
+        derive one from the strategy: sharding.degree over 'sharding',
+        remaining devices over 'dp'."""
+        if self._hcg is not None:
+            return self._hcg
+        from .. import topology
+
+        cur = topology._default_hcg
+        # an Engine-derived mesh (ours or another Engine's) is NOT a user
+        # annotation — each Engine re-plans from its own strategy
+        if cur is not None and not getattr(cur, "_engine_derived", False):
+            self._hcg = cur
+            return self._hcg
+        import jax
+
+        ndev = len(jax.devices())
+        sh = self.strategy.sharding
+        if sh.enable:
+            degree = int(sh.degree) or ndev
+            degree = min(degree, ndev)
+            while ndev % degree:
+                degree -= 1
+            self._hcg = HybridCommunicateGroup(dp=ndev // degree,
+                                               sharding=degree)
+        else:
+            self._hcg = HybridCommunicateGroup(dp=ndev)
+        self._hcg._engine_derived = True
+        set_hybrid_communicate_group(self._hcg)
+        return self._hcg
+
+    def _apply_amp(self):
+        """strategy.amp: o2 == cast model weights to the AMP dtype
+        (bf16-first — the convert_to_mixed_precision analog); o1 relies
+        on the dispatch-level autocast lists."""
+        amp = self.strategy.amp
+        if amp.enable and not self._prepared_amp and \
+                str(amp.level).lower() == "o2":
+            self.model.to(dtype=amp.dtype)
+            self._prepared_amp = True
+
+    def _ensure_step(self) -> DistributedTrainStep:
+        if self._step is None:
+            hcg = self._ensure_hcg()
+            self._apply_amp()
+            sh = self.strategy.sharding
+            stage = int(sh.stage) if sh.enable else 0
+            gm = self.strategy.gradient_merge
+            if gm.enable and int(gm.k_steps) > 1:
+                raise NotImplementedError(
+                    "gradient_merge under the auto-parallel Engine: use "
+                    "jit.TrainStep(accumulate_steps=k) directly")
+            self._step = DistributedTrainStep(
+                self.model, self.optimizer, self.loss, hcg=hcg,
+                sharding_stage=stage, offload=bool(sh.offload))
+        return self._step
+
+    # -- train/eval/predict loops -----------------------------------------
+    def fit(self, train_data=None, valid_data=None, batch_size=1, epochs=1,
+            steps_per_epoch=None, log_freq=10, valid_freq=1, verbose=0,
+            shuffle=True, num_workers=0, drop_last=True):
+        step = self._ensure_step()
+        loader = _to_loader(train_data, batch_size, shuffle, num_workers,
+                            drop_last)
+        history = {"loss": []}
+        for epoch in range(epochs):
+            self.model.train()
+            for m in self.metrics:
+                m.reset()
+            losses = []
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                ins, label = _split_batch(batch)
+                loss = step(*ins, label=label)
+                losses.append(float(loss))
+                if verbose and (i % max(log_freq, 1) == 0):
+                    print(f"epoch {epoch} step {i}: loss {losses[-1]:.4f}")
+            history["loss"].append(float(np.mean(losses)) if losses else None)
+            if valid_data is not None and (epoch + 1) % valid_freq == 0:
+                logs = self.evaluate(valid_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+                for k, v in logs.items():
+                    history.setdefault(f"eval_{k}", []).append(v)
+        self.history = history
+        return history
+
+    def _build_eval(self):
+        import jax
+
+        network, loss_fn = self.model, self.loss
+        params = list(network.parameters())
+        buffers = list(network.buffers()) if hasattr(network, "buffers") \
+            else []
+
+        def pure_eval(param_arrays, buf_arrays, inputs, label):
+            from paddle_tpu.jit.api import bound_state
+
+            state = params + buffers
+            arrays = list(param_arrays) + list(buf_arrays)
+            with bound_state(zip(state, arrays), state):
+                out = network(*[Tensor._wrap(i) for i in inputs])
+                loss = None
+                if loss_fn is not None and label is not None:
+                    loss = loss_fn(out, Tensor._wrap(label))
+                unwrap = lambda t: t._array if isinstance(t, Tensor) else t
+                return (jax.tree_util.tree_map(
+                            unwrap, out,
+                            is_leaf=lambda t: isinstance(t, Tensor)),
+                        None if loss is None else unwrap(loss))
+
+        return jax.jit(pure_eval), params, buffers
+
+    def _eval_batch(self, ins, label):
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..spmd import _unwrap
+
+        hcg = self._ensure_hcg()
+        from paddle_tpu.framework.flags import debug_epoch
+
+        key = (self.model.training, debug_epoch())
+        if self._eval_jit is None or self._eval_jit[3] != key:
+            self._eval_jit = (*self._build_eval(), key)
+        fn, params, buffers, _ = self._eval_jit
+        axes = tuple(a for a in ("dp", "sharding")
+                     if hcg.axis_size(a) > 1) or None
+        nshard = int(np.prod([hcg.axis_size(a) for a in (axes or ())]))
+
+        def put(x):
+            a = _unwrap(x)
+            a = np.asarray(a) if not hasattr(a, "shape") else a
+            # tail batches that don't divide the mesh run replicated
+            spec = P(axes) if a.ndim >= 1 and nshard > 1 and \
+                a.shape[0] % nshard == 0 else P()
+            return jax.device_put(a, NamedSharding(hcg.mesh, spec))
+
+        ins = tuple(put(i) for i in ins)
+        label = None if label is None else put(label)
+        return fn([p._array for p in params],
+                  [b._array for b in buffers], ins, label)
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, log_freq=10,
+                 verbose=0, num_workers=0):
+        self.model.eval()
+        loader = _to_loader(valid_data, batch_size, False, num_workers,
+                            drop_last=False)
+        for m in self.metrics:
+            m.reset()
+        losses = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            ins, label = _split_batch(batch)
+            out, loss = self._eval_batch(ins, label)
+            if loss is not None:
+                losses.append(float(loss))
+            pred = out[0] if isinstance(out, (list, tuple)) else out
+            for m in self.metrics:
+                if hasattr(m, "compute") and label is not None:
+                    m.update(m.compute(Tensor._wrap(_np(pred)),
+                                       Tensor._wrap(_np(label))))
+                else:
+                    m.update(_np(pred), _np(label))
+        logs = {"loss": float(np.mean(losses))} if losses else {}
+        for m in self.metrics:
+            name = m.name() if callable(getattr(m, "name", None)) else m._name
+            logs[name] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, steps=None, verbose=0,
+                num_workers=0):
+        self.model.eval()
+        loader = _to_loader(test_data, batch_size, False, num_workers,
+                            drop_last=False)
+        outs = []
+        for i, batch in enumerate(loader):
+            if steps is not None and i >= steps:
+                break
+            ins, _ = _split_batch(batch)
+            out, _ = self._eval_batch(ins, None)
+            pred = out[0] if isinstance(out, (list, tuple)) else out
+            outs.append(np.asarray(pred))
+        return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+
+    def dataloader(self, dataset, batch_size=1, shuffle=False,
+                   num_workers=0, drop_last=True, mode=None):
+        return _to_loader(dataset, batch_size, shuffle, num_workers,
+                          drop_last)
+
+    # -- cost model / tuner ------------------------------------------------
+    def cost(self, inputs=None, labels=None, mode=None):
+        """Compile the planned step and return XLA's cost analysis — the
+        reference's auto_parallel/cost_model.py role, answered by the
+        real compiler instead of an estimator. `inputs`/`labels` are
+        example batches (arrays or Tensors)."""
+        if inputs is None:
+            raise ValueError("cost() needs an example batch: "
+                             "engine.cost(inputs, labels)")
+        step = self._ensure_step()
+        ins = inputs if isinstance(inputs, (list, tuple)) else (inputs,)
+        lowered = step.lower(*ins, label=labels)
+        compiled = lowered.compile()
+        out = {"flops": None, "bytes_accessed": None,
+               "peak_memory_bytes": None}
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            if ca:
+                out["flops"] = ca.get("flops")
+                out["bytes_accessed"] = ca.get("bytes accessed")
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                out["peak_memory_bytes"] = (
+                    ma.temp_size_in_bytes + ma.argument_size_in_bytes +
+                    ma.output_size_in_bytes)
+        except Exception:
+            pass
+        return out
+
+    def tune(self, inputs, labels=None, candidates=(0, 2, 3)):
+        """Minimal optimization tuner (reference _optimization_tuning
+        :639): compile each candidate sharding stage, pick the lowest
+        peak memory (ties -> lower stage). Returns the chosen stage and
+        per-candidate costs."""
+        from .. import topology
+
+        def replan(stage):
+            """A fresh plan per candidate: drop the cached step AND the
+            engine-derived mesh so the sharding axis actually changes."""
+            self._step = None
+            if self._hcg is not None and \
+                    getattr(self._hcg, "_engine_derived", False):
+                if topology._default_hcg is self._hcg:
+                    topology._default_hcg = None
+                self._hcg = None
+            self.strategy.sharding.enable = stage > 0
+            self.strategy.sharding.stage = max(stage, 1)
+
+        saved = (self.strategy.sharding.enable, self.strategy.sharding.stage)
+        results = {}
+        best, best_key = None, None
+        for stage in candidates:
+            replan(stage)
+            try:
+                c = self.cost(inputs, labels)
+            except Exception as e:  # a plan that fails to compile loses
+                results[stage] = {"error": str(e)[:200]}
+                continue
+            results[stage] = c
+            key = (c["peak_memory_bytes"] if c["peak_memory_bytes"]
+                   is not None else float("inf"), stage)
+            if best_key is None or key < best_key:
+                best_key, best = key, stage
+        if best is not None:
+            replan(best)
+        else:  # every candidate failed: restore the user's strategy
+            self._step = None
+            self.strategy.sharding.enable, self.strategy.sharding.stage = saved
+        if self.strategy.tuning.verbose:
+            for s, c in results.items():
+                print(f"tune stage={s}: {c}")
+        return best, results
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        import paddle_tpu
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        paddle_tpu.save(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None:
+            paddle_tpu.save(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import paddle_tpu
+
+        self.model.set_state_dict(paddle_tpu.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if load_optimizer and self.optimizer is not None \
+                and os.path.exists(opt_path):
+            self.optimizer.set_state_dict(paddle_tpu.load(opt_path))
+        # params changed out from under any compiled step
+        self._step = None
+        self._eval_jit = None
+
+    # -- mode plumbing (reference parity) ----------------------------------
+    def to_mode(self, mode):
+        assert mode in ("train", "eval", "predict")
+        self._mode = mode
+        return self
+
+    @property
+    def main_program(self):  # static-graph parity: nearest analog
+        raise NotImplementedError(
+            "no Program IR on the TPU build; the compiled artifact is the "
+            "jitted step (DistributedTrainStep) — see engine.cost() for "
+            "its XLA analysis")

@@ -1,0 +1,102 @@
+"""Auto-parallel Strategy — config sections mirroring the reference's
+python/paddle/distributed/auto_parallel/strategy.py (BaseConfig at :20,
+Strategy at :129) and constants.py defaults.
+
+TPU-native: the sections that matter map onto our SPMD step factory
+(sharding stage, recompute, amp dtype, gradient merge); the reference's
+program-rewrite passes become arguments to DistributedTrainStep.
+"""
+from __future__ import annotations
+
+import copy
+
+
+class BaseConfig:
+    _defaults: dict = {}
+
+    def __init__(self, config_dict=None):
+        for k, v in self._defaults.items():
+            setattr(self, k, copy.deepcopy(v))
+        if config_dict:
+            self.from_dict(config_dict)
+
+    def from_dict(self, config_dict):
+        for k, v in dict(config_dict).items():
+            if k not in self._defaults:
+                raise ValueError(
+                    f"unknown {type(self).__name__} field {k!r}; "
+                    f"valid: {sorted(self._defaults)}")
+            setattr(self, k, v)
+        return self
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._defaults}
+
+    def get(self, k, d=None):
+        return getattr(self, k, d)
+
+    def __repr__(self):
+        body = ", ".join(f"{k}={getattr(self, k)!r}" for k in self._defaults)
+        return f"{type(self).__name__}({body})"
+
+
+class RecomputeConfig(BaseConfig):
+    _defaults = {"enable": False, "checkpoints": None,
+                 "no_recompute_segments": []}
+
+
+class AMPConfig(BaseConfig):
+    # bf16-first: the TPU mixed-precision default; fp16 kept for parity
+    _defaults = {"enable": False, "dtype": "bfloat16", "level": "o2",
+                 "init_loss_scaling": 32768.0, "use_master_weights": True}
+
+
+class ShardingConfig(BaseConfig):
+    _defaults = {"enable": False, "stage": 1, "degree": 0,
+                 "offload": False}
+
+
+class GradientMergeConfig(BaseConfig):
+    _defaults = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class TuningConfig(BaseConfig):
+    _defaults = {"enable": False, "profile_start_step": 1,
+                 "profile_end_step": 1, "verbose": True}
+
+
+class DatasetConfig(BaseConfig):
+    _defaults = {"enable": False, "num_shards": 1}
+
+
+class Strategy(BaseConfig):
+    """Usage (reference parity):
+        strategy = auto.Strategy()
+        strategy.sharding.enable = True
+        strategy.sharding.stage = 2
+        engine = auto.Engine(model, loss, opt, strategy=strategy)
+    """
+
+    _defaults = {"auto_mode": "semi", "seed": None, "split_data": True}
+    _sections = {
+        "recompute": RecomputeConfig,
+        "amp": AMPConfig,
+        "sharding": ShardingConfig,
+        "gradient_merge": GradientMergeConfig,
+        "tuning": TuningConfig,
+        "dataset": DatasetConfig,
+    }
+
+    def __init__(self, config=None):
+        config = dict(config or {})
+        section_cfg = {k: config.pop(k) for k in list(config)
+                       if k in self._sections}
+        super().__init__(config)
+        for name, cls in self._sections.items():
+            setattr(self, name, cls(section_cfg.get(name)))
+
+    def to_dict(self):
+        d = super().to_dict()
+        for name in self._sections:
+            d[name] = getattr(self, name).to_dict()
+        return d

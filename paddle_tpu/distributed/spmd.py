@@ -34,7 +34,15 @@ from .topology import HybridCommunicateGroup, get_hybrid_communicate_group
 
 
 def _unwrap(x):
-    return x._array if isinstance(x, Tensor) else x
+    if isinstance(x, Tensor):
+        return x._array
+    if isinstance(x, (list, tuple)) and x and \
+            all(np.isscalar(e) or getattr(e, "ndim", None) == 0 for e in x):
+        # a DataLoader label batch collated as a list of scalars —
+        # device_put would treat it as a pytree of rank-0 leaves; lists
+        # of arrays stay pytrees (structured model inputs)
+        return np.asarray(x)
+    return x
 
 
 def param_pspec(param, hcg: HybridCommunicateGroup, sharding_stage: int):
@@ -181,10 +189,9 @@ class DistributedTrainStep:
         return jax.jit(step_fn, donate_argnums=donate,
                        out_shardings=out_shardings)
 
-    def __call__(self, *inputs, label=None):
-        if label is None and len(inputs) >= 2:
-            *inputs, label = inputs
-            inputs = tuple(inputs)
+    def _prep_args(self, inputs, label, advance_rng=True):
+        """Place params, (re)build the jitted step, and stage one call's
+        argument tuple (shared by __call__ and lower)."""
         if not self._placed:
             self.place_params()
         from paddle_tpu.framework.flags import debug_epoch
@@ -200,7 +207,7 @@ class DistributedTrainStep:
             jax.device_put(_unwrap(i), bs) for i in inputs)
         label_arr = jax.device_put(_unwrap(label), bs) if label is not None else None
         from paddle_tpu.core import random as random_mod
-        from paddle_tpu.jit.api import gather_accums, scatter_accums
+        from paddle_tpu.jit.api import gather_accums
 
         param_arrays = [p._array for p in self._params]
         accums = gather_accums(opt, self._acc_idx)
@@ -213,9 +220,37 @@ class DistributedTrainStep:
                       for k, lst in accums.items()}
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
-        loss, new_params, new_accums = self._jitted(
-            param_arrays, accums, lr, stepc, in_arrays, label_arr,
-            random_mod.next_key())
+        if advance_rng:
+            key = random_mod.next_key()
+        else:  # lowering only traces — don't perturb the global stream
+            # same (typed) key flavor as next_key() so the lowered
+            # signature matches the executed one (no duplicate compile)
+            key = jax.random.key(0)
+        return (param_arrays, accums, lr, stepc, in_arrays, label_arr, key)
+
+    @staticmethod
+    def _split_label(inputs, label):
+        """Positional-label convention: step(x, y) == step(x, label=y)."""
+        if label is None and len(inputs) >= 2:
+            *inputs, label = inputs
+        return tuple(inputs), label
+
+    def lower(self, *inputs, label=None):
+        """jax .lower() of the compiled step on these inputs — feeds the
+        Engine cost model (XLA's own cost analysis replaces the
+        reference's hand-built auto_parallel/cost_model.py)."""
+        inputs, label = self._split_label(inputs, label)
+        # also builds self._jitted
+        args = self._prep_args(inputs, label, advance_rng=False)
+        return self._jitted.lower(*args)
+
+    def __call__(self, *inputs, label=None):
+        inputs, label = self._split_label(inputs, label)
+        args = self._prep_args(inputs, label)
+        from paddle_tpu.jit.api import scatter_accums
+
+        opt = self.optimizer
+        loss, new_params, new_accums = self._jitted(*args)
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
         if self.offload:

@@ -9,20 +9,71 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.dispatch import apply, as_tensor
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "rfft2",
-           "irfft2", "fftn", "ifftn", "fftshift", "ifftshift",
-           "fftfreq", "rfftfreq", "hfft", "ihfft"]
+           "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftshift",
+           "ifftshift", "fftfreq", "rfftfreq", "hfft", "ihfft",
+           "hfft2", "ihfft2", "hfftn", "ihfftn"]
 
 
-def _mk(name, jfn, takes_n=True):
+def _backend_fft_ok() -> bool:
+    """Whether the default backend lowers FFT + holds complex buffers —
+    exactly device.supports_complex() (production CPU/GPU/TPU XLA: yes;
+    the experimental axon tunnel: no, and it cannot be probed at runtime
+    because a failed op wedges its process state)."""
+    from paddle_tpu.core.device import supports_complex
+
+    return supports_complex()
+
+
+def _dispatch(opname, call, x):
+    """Native lowering first; on an FFT-less backend, eager calls hop to
+    the CPU backend via device_put (differentiable — jax transposes the
+    transfers, so gradients land back on the accelerator). Inside a jit
+    trace there is no fallback: the op lowers natively (compile for the
+    axon tunnel will fail loudly rather than silently degrade).
+
+    The hop decision is made OUTSIDE the op function on the concrete
+    input so jax.vjp of the wrapped fn still routes through the CPU."""
+    import jax
+
+    t = as_tensor(x)
+    if isinstance(t._array, jax.core.Tracer) or _backend_fft_ok():
+        return apply(opname, call, t)
+
+    try:
+        dev = next(iter(t._array.devices()))
+    except Exception:
+        dev = None
+    try:
+        cpu = jax.devices("cpu")[0]
+    except Exception:  # no cpu plugin in this config: lower natively
+        return apply(opname, call, t)
+
+    def hop(a):
+        # default_device(cpu) so internal constants (e.g. the norm
+        # scaling factor) are created CPU-side, not on the accelerator
+        with jax.default_device(cpu):
+            out = call(jax.device_put(a, cpu))
+        # real results rejoin the accelerator; complex ones stay
+        # CPU-committed (a backend that can't lower FFT can't hold
+        # complex buffers either — chained transforms keep working on
+        # CPU and rejoin at the first real-valued output)
+        if dev is None or jnp.issubdtype(out.dtype, jnp.complexfloating):
+            return out
+        return jax.device_put(out, dev)
+
+    return apply(opname, hop, t)
+
+
+def _mk(opname, jfn, takes_n=True):
     if takes_n:
         def op(x, n=None, axis=-1, norm="backward", name=None):
-            return apply(name, lambda a: jfn(a, n=n, axis=axis, norm=norm),
-                         as_tensor(x))
+            return _dispatch(opname,
+                             lambda a: jfn(a, n=n, axis=axis, norm=norm), x)
     else:
         def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
-            return apply(name, lambda a: jfn(a, s=s, axes=axes, norm=norm),
-                         as_tensor(x))
-    op.__name__ = name
+            return _dispatch(opname,
+                             lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+    op.__name__ = opname
     return op
 
 
@@ -38,14 +89,61 @@ rfft2 = _mk("rfft2", jnp.fft.rfft2, takes_n=False)
 irfft2 = _mk("irfft2", jnp.fft.irfft2, takes_n=False)
 
 
-def fftn(x, s=None, axes=None, norm="backward", name=None):
-    return apply("fftn", lambda a: jnp.fft.fftn(a, s=s, axes=axes,
-                                                norm=norm), as_tensor(x))
+def _mkn(opname, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return _dispatch(opname,
+                         lambda a: jfn(a, s=s, axes=axes, norm=norm), x)
+    op.__name__ = opname
+    return op
 
 
-def ifftn(x, s=None, axes=None, norm="backward", name=None):
-    return apply("ifftn", lambda a: jnp.fft.ifftn(a, s=s, axes=axes,
-                                                  norm=norm), as_tensor(x))
+fftn = _mkn("fftn", jnp.fft.fftn)
+ifftn = _mkn("ifftn", jnp.fft.ifftn)
+rfftn = _mkn("rfftn", jnp.fft.rfftn)
+irfftn = _mkn("irfftn", jnp.fft.irfftn)
+
+
+def _hermitian_nd(opname, axis_fn):
+    """jnp.fft has no hfft2/hfftn; compose from the 1-d hermitian
+    transform over the last axis + complex FFTs over the rest, matching
+    scipy/paddle semantics. Order matters: hfft* runs the complex FFTs
+    first and the C2R hfft over the last axis LAST (real output);
+    ihfft* runs the R2C ihfft over the last axis FIRST."""
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        def run(a):
+            if axes is not None:
+                ax = list(axes)
+            elif "2" in opname:
+                ax = [-2, -1]
+            elif s is not None:
+                ax = list(range(-len(s), 0))  # last len(s) axes
+            else:
+                ax = list(range(a.ndim))
+            *rest, last = ax
+            nlast = None if s is None else s[-1]
+
+            def complex_ffts(out):
+                for i, r in enumerate(rest):
+                    nr = None if s is None else s[i]
+                    jfn = jnp.fft.fft if opname.startswith("h") else \
+                        jnp.fft.ifft
+                    out = jfn(out, n=nr, axis=r, norm=norm)
+                return out
+
+            if opname.startswith("h"):  # C2R last
+                return axis_fn(complex_ffts(a), n=nlast, axis=last,
+                               norm=norm)
+            # R2C first
+            return complex_ffts(axis_fn(a, n=nlast, axis=last, norm=norm))
+        return _dispatch(opname, run, x)
+    op.__name__ = opname
+    return op
+
+
+hfft2 = _hermitian_nd("hfft2", jnp.fft.hfft)
+ihfft2 = _hermitian_nd("ihfft2", jnp.fft.ihfft)
+hfftn = _hermitian_nd("hfftn", jnp.fft.hfft)
+ihfftn = _hermitian_nd("ihfftn", jnp.fft.ihfft)
 
 
 def fftshift(x, axes=None, name=None):
@@ -59,10 +157,16 @@ def ifftshift(x, axes=None, name=None):
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):
+    from paddle_tpu.core import dtype as dtypes
+
     out = jnp.fft.fftfreq(n, d=d)
-    return Tensor._wrap(out.astype(dtype) if dtype is not None else out)
+    return Tensor._wrap(out.astype(dtypes.to_jax(dtype))
+                        if dtype is not None else out)
 
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from paddle_tpu.core import dtype as dtypes
+
     out = jnp.fft.rfftfreq(n, d=d)
-    return Tensor._wrap(out.astype(dtype) if dtype is not None else out)
+    return Tensor._wrap(out.astype(dtypes.to_jax(dtype))
+                        if dtype is not None else out)

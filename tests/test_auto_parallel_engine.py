@@ -1,0 +1,183 @@
+"""Auto-parallel Engine tests (SURVEY §2.5 auto-parallel row; reference
+python/paddle/distributed/auto_parallel/engine.py): fit/evaluate/predict
+over the virtual 8-device mesh, strategy-driven sharding plans, the XLA
+cost model, and the stage tuner.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import auto_parallel as auto
+from paddle_tpu.distributed import topology
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _RandDS(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=128, d=16, seed=0):
+        rs = np.random.RandomState(seed)
+        self.x = rs.randn(n, d).astype(np.float32)
+        w = rs.randn(d)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp(d=16, h=32, classes=2):
+    return nn.Sequential(nn.Linear(d, h), nn.ReLU(), nn.Linear(h, classes))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_topology():
+    saved = topology._default_hcg
+    topology._default_hcg = None
+    yield
+    topology._default_hcg = saved
+
+
+def _engine(strategy=None, metrics=None):
+    paddle.seed(0)
+    model = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model.parameters())
+    return auto.Engine(model, F.cross_entropy, opt, metrics=metrics,
+                       strategy=strategy), model
+
+
+def test_engine_fit_converges_and_evaluates():
+    eng, _ = _engine(metrics=[Accuracy()])
+    ds = _RandDS()
+    hist = eng.fit(ds, epochs=3, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0]
+    logs = eng.evaluate(ds, batch_size=32)
+    assert logs["acc"] > 0.8 and np.isfinite(logs["loss"])
+    preds = eng.predict(ds, batch_size=32)
+    assert preds.shape == (128, 2)
+
+
+def test_engine_strategy_sharding_plan():
+    strategy = auto.Strategy()
+    strategy.sharding.enable = True
+    strategy.sharding.stage = 2
+    strategy.sharding.degree = 4
+    eng, _ = _engine(strategy=strategy)
+    hist = eng.fit(_RandDS(), epochs=2, batch_size=32)
+    assert hist["loss"][-1] < hist["loss"][0]
+    hcg = eng._ensure_hcg()
+    assert hcg.axis_size("sharding") == 4 and hcg.axis_size("dp") == 2
+
+
+def test_engine_respects_user_topology():
+    hcg = topology.HybridCommunicateGroup(dp=2, mp=1)
+    topology.set_hybrid_communicate_group(hcg)
+    eng, _ = _engine()
+    assert eng._ensure_hcg() is hcg
+
+
+def test_engine_cost_model():
+    eng, _ = _engine()
+    ds = _RandDS()
+    x = ds.x[:32]
+    y = ds.y[:32]
+    cost = eng.cost(x, y)
+    assert cost["flops"] is None or cost["flops"] > 0
+    # the lowered step must still execute afterwards
+    hist = eng.fit(ds, epochs=1, batch_size=32)
+    assert np.isfinite(hist["loss"][0])
+
+
+def test_engine_tuner_picks_a_stage():
+    strategy = auto.Strategy()
+    strategy.tuning.enable = True
+    strategy.tuning.verbose = False
+    eng, _ = _engine(strategy=strategy)
+    ds = _RandDS()
+    best, results = eng.tune(ds.x[:32], ds.y[:32], candidates=(0, 2))
+    assert best in (0, 2) and len(results) == 2
+    hist = eng.fit(ds, epochs=1, batch_size=32)
+    assert np.isfinite(hist["loss"][0])
+
+
+def test_engine_predict_keeps_tail_batch():
+    eng, _ = _engine()
+    ds = _RandDS(n=100)  # 100 % 32 != 0: tail of 4 runs replicated
+    eng.fit(ds, epochs=1, batch_size=32)
+    preds = eng.predict(ds, batch_size=32)
+    assert preds.shape == (100, 2)
+
+
+def test_engine_second_engine_replans_its_own_strategy():
+    engA, _ = _engine()
+    engA.fit(_RandDS(), epochs=1, batch_size=32)  # publishes a dp-only mesh
+    strategy = auto.Strategy()
+    strategy.sharding.enable = True
+    strategy.sharding.degree = 4
+    engB, _ = _engine(strategy=strategy)
+    assert engB._ensure_hcg().axis_size("sharding") == 4
+
+
+def test_engine_save_load_roundtrip(tmp_path):
+    eng, model = _engine()
+    ds = _RandDS()
+    eng.fit(ds, epochs=1, batch_size=32)
+    path = str(tmp_path / "auto" / "ckpt")
+    eng.save(path)
+
+    paddle.seed(1)
+    model2 = _mlp()
+    opt2 = paddle.optimizer.Adam(learning_rate=0.05,
+                                 parameters=model2.parameters())
+    eng2 = auto.Engine(model2, F.cross_entropy, opt2)
+    eng2.load(path)
+    for p1, p2 in zip(model.parameters(), model2.parameters()):
+        np.testing.assert_allclose(np.asarray(p1._array),
+                                   np.asarray(p2._array), rtol=1e-6)
+    # loaded engine keeps training
+    hist = eng2.fit(ds, epochs=1, batch_size=32)
+    assert np.isfinite(hist["loss"][0])
+
+
+def test_engine_amp_o2_casts_weights():
+    strategy = auto.Strategy()
+    strategy.amp.enable = True
+    eng, model = _engine(strategy=strategy)
+    eng.fit(_RandDS(), epochs=1, batch_size=32)
+    assert str(model.parameters()[0].dtype).endswith("bfloat16")
+
+
+def test_strategy_roundtrip_and_validation():
+    s = auto.Strategy({"sharding": {"enable": True, "stage": 3}})
+    assert s.sharding.enable and s.sharding.stage == 3
+    d = s.to_dict()
+    assert d["sharding"]["stage"] == 3
+    with pytest.raises(ValueError):
+        auto.Strategy({"sharding": {"bogus_field": 1}})
+
+
+def test_cost_does_not_advance_global_rng():
+    from paddle_tpu.core import random as random_mod
+
+    eng, _ = _engine()
+    ds = _RandDS()
+    state_before = random_mod._gen().get_state()
+    eng.cost(ds.x[:32], ds.y[:32])
+    state_after = random_mod._gen().get_state()
+    assert state_before == state_after
+
+
+def test_step_structured_pytree_inputs_preserved():
+    """A list of equal-shape arrays is a pytree input, not a stack."""
+    from paddle_tpu.distributed.spmd import _unwrap
+
+    a = np.ones((4, 3), np.float32)
+    out = _unwrap([a, a])
+    assert isinstance(out, list) and len(out) == 2  # untouched pytree
+    assert isinstance(_unwrap([np.int64(1), np.int64(0)]), np.ndarray)

@@ -53,3 +53,25 @@ def test_fft_gradient_flows():
     assert x.grad is not None
     # Parseval: d/dx sum|rfft(x)|^2 relates to x linearly; check nonzero
     assert float(np.abs(np.asarray(x.grad._array)).sum()) > 0
+
+
+def test_fft_nd_real_and_hermitian_families():
+    rs = np.random.RandomState(3)
+    x = rs.randn(4, 8).astype(np.float32)
+    r = np.asarray(paddle.fft.rfftn(paddle.to_tensor(x))._array)
+    np.testing.assert_allclose(r, np.fft.rfftn(x), rtol=1e-4, atol=1e-4)
+    back = np.asarray(paddle.fft.irfftn(
+        paddle.to_tensor(r), s=(4, 8))._array)
+    np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-5)
+    c = (rs.randn(4, 5) + 1j * rs.randn(4, 5)).astype(np.complex64)
+    import scipy.fft as sfft
+
+    h = np.asarray(paddle.fft.hfft2(paddle.to_tensor(c))._array)
+    np.testing.assert_allclose(h, sfft.hfft2(c), rtol=1e-3, atol=1e-3)
+    assert not np.iscomplexobj(h)  # hfft* output is real
+    real = rs.randn(4, 8).astype(np.float32)
+    ih = np.asarray(paddle.fft.ihfft2(paddle.to_tensor(real))._array)
+    np.testing.assert_allclose(ih, sfft.ihfft2(real), rtol=1e-3, atol=1e-4)
+    # fftfreq honors dtype aliases through the canonical converter
+    assert str(paddle.fft.fftfreq(8, dtype="float32").dtype) \
+        .endswith("float32")

@@ -103,9 +103,13 @@ class Engine:
         sh = self.strategy.sharding
         if sh.enable:
             degree = int(sh.degree) or ndev
-            degree = min(degree, ndev)
-            while ndev % degree:
-                degree -= 1
+            if ndev % degree:
+                # an explicit degree the mesh cannot realize is an error,
+                # not a silent re-plan
+                raise ValueError(
+                    f"sharding.degree={degree} does not divide the "
+                    f"{ndev}-device mesh; pick a divisor of {ndev} or "
+                    f"leave degree=0 for automatic")
             self._hcg = HybridCommunicateGroup(dp=ndev // degree,
                                                sharding=degree)
         else:

@@ -188,3 +188,91 @@ def test_pipeline_stack_params_sharded_over_pp():
     model = _mk_model(pp=2)
     for p in model.stack._stacked:
         assert p.dist_spec is not None and tuple(p.dist_spec)[0] == "pp"
+
+
+def _mk_nonuniform(pp, n_blocks=5, seed=0, **kw):
+    paddle.seed(seed)
+    set_hybrid_communicate_group(HybridCommunicateGroup(pp=pp))
+    descs = [
+        LayerDesc(Embed, 64, 16),
+        *[LayerDesc(Block, 16) for _ in range(n_blocks)],
+        LayerDesc(Head, 16, 64),
+    ]
+    return PipelineLayer(descs, num_stages=pp, num_microbatches=4, **kw)
+
+
+def test_segment_layers_weighted():
+    # heavy first layer pulls the boundary early
+    assert SegmentLayers.weighted([8, 1, 1, 1, 1], 2) == [0, 1, 5]
+    assert SegmentLayers.weighted([1, 1, 1, 1], 2) == [0, 2, 4]
+    b = SegmentLayers.weighted([1] * 7, 3)
+    assert b[0] == 0 and b[-1] == 7 and len(b) == 4
+    assert all(b[i] < b[i + 1] for i in range(3))
+
+
+def test_pipeline_nonuniform_forward_parity():
+    """5 body blocks over pp=2 (stages of 3 and 2, padded+masked):
+    pipelined == sequential == a plain eager stack of the same layers."""
+    model = _mk_nonuniform(pp=2)
+    assert model.stack.stage_counts == [3, 2]
+    assert not model.stack.uniform
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 64, (8, 12), np.int32))
+    out_pipe = model(ids).numpy()
+
+    h = model.pre_layers[0](ids)
+    h_seq = model.stack(h, pipelined=False)
+    for layer, ffn in model._post:
+        h_seq = ffn(layer, h_seq) if ffn is not None else layer(h_seq)
+    np.testing.assert_allclose(out_pipe, h_seq.numpy(), atol=1e-4)
+
+
+def test_pipeline_nonuniform_train_parity():
+    """Non-uniform pp=2 training == the same model at pp=1."""
+    def run2(pp, steps=3):
+        model = _mk_nonuniform(pp=pp, seed=3)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        step = DistributedTrainStep(
+            model, opt,
+            lambda out, lab: F.cross_entropy(
+                out.reshape([-1, 64]), lab.reshape([-1])))
+        rng = np.random.RandomState(7)
+        losses = []
+        for _ in range(steps):
+            ids = paddle.to_tensor(rng.randint(0, 64, (8, 12), np.int32))
+            losses.append(float(step(ids, ids)))
+        return losses
+
+    l_pp = run2(2)
+    l_seq = run2(1)
+    np.testing.assert_allclose(l_pp, l_seq, rtol=2e-3, atol=2e-4)
+
+
+def test_pipeline_seg_method_parameters():
+    model = _mk_nonuniform(pp=2, seg_method="parameters")
+    counts = model.stack.stage_counts
+    assert sum(counts) == 5 and len(counts) == 2
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 64, (8, 12), np.int32))
+    assert np.isfinite(model(ids).numpy()).all()
+
+
+def test_pipeline_padded_slots_get_zero_grad():
+    """The padded slot's parameters must not move during training."""
+    model = _mk_nonuniform(pp=2, seed=5)
+    # stacked params: [S=2, k_max=3, ...]; stage 1 slot 2 is the pad
+    before = [np.asarray(p._array)[1, 2].copy()
+              for p in model.stack._stacked]
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=model.parameters())
+    step = DistributedTrainStep(
+        model, opt,
+        lambda out, lab: F.cross_entropy(
+            out.reshape([-1, 64]), lab.reshape([-1])))
+    rng = np.random.RandomState(2)
+    for _ in range(2):
+        ids = paddle.to_tensor(rng.randint(0, 64, (8, 12), np.int32))
+        step(ids, ids)
+    for b, p in zip(before, model.stack._stacked):
+        np.testing.assert_allclose(b, np.asarray(p._array)[1, 2], atol=1e-7)

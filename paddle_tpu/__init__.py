@@ -99,6 +99,7 @@ from paddle_tpu import static  # noqa: E402
 from paddle_tpu import incubate  # noqa: E402
 from paddle_tpu import linalg  # noqa: E402
 from paddle_tpu import fft  # noqa: E402
+from paddle_tpu import utils  # noqa: E402
 from paddle_tpu.hapi import callbacks  # noqa: E402
 
 # paddle-style helpers

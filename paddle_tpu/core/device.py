@@ -96,6 +96,13 @@ def supports_complex() -> bool:
     return _supports_complex
 
 
+def supports_host_callback() -> bool:
+    """Whether the default backend implements host send/recv callbacks
+    (jax pure_callback / io_callback / debug.callback). Production XLA
+    backends do; the axon tunnel rejects them with UNIMPLEMENTED."""
+    return supports_complex()  # same capability gap, same detection
+
+
 def is_compiled_with_cuda() -> bool:  # API parity; this build has zero CUDA
     return False
 

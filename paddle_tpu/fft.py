@@ -25,43 +25,13 @@ def _backend_fft_ok() -> bool:
 
 
 def _dispatch(opname, call, x):
-    """Native lowering first; on an FFT-less backend, eager calls hop to
-    the CPU backend via device_put (differentiable — jax transposes the
-    transfers, so gradients land back on the accelerator). Inside a jit
-    trace there is no fallback: the op lowers natively (compile for the
-    axon tunnel will fail loudly rather than silently degrade).
+    """Native FFT lowering first; on an FFT-less backend, eager calls
+    hop to the CPU backend (ops.dispatch.apply_with_cpu_fallback)."""
+    from paddle_tpu.ops.dispatch import apply_with_cpu_fallback
 
-    The hop decision is made OUTSIDE the op function on the concrete
-    input so jax.vjp of the wrapped fn still routes through the CPU."""
-    import jax
-
-    t = as_tensor(x)
-    if isinstance(t._array, jax.core.Tracer) or _backend_fft_ok():
-        return apply(opname, call, t)
-
-    try:
-        dev = next(iter(t._array.devices()))
-    except Exception:
-        dev = None
-    try:
-        cpu = jax.devices("cpu")[0]
-    except Exception:  # no cpu plugin in this config: lower natively
-        return apply(opname, call, t)
-
-    def hop(a):
-        # default_device(cpu) so internal constants (e.g. the norm
-        # scaling factor) are created CPU-side, not on the accelerator
-        with jax.default_device(cpu):
-            out = call(jax.device_put(a, cpu))
-        # real results rejoin the accelerator; complex ones stay
-        # CPU-committed (a backend that can't lower FFT can't hold
-        # complex buffers either — chained transforms keep working on
-        # CPU and rejoin at the first real-valued output)
-        if dev is None or jnp.issubdtype(out.dtype, jnp.complexfloating):
-            return out
-        return jax.device_put(out, dev)
-
-    return apply(opname, hop, t)
+    return apply_with_cpu_fallback(apply, opname, call, as_tensor(x),
+                                   _backend_fft_ok,
+                                   complex_stays_on_cpu=True)
 
 
 def _mk(opname, jfn, takes_n=True):

@@ -147,3 +147,42 @@ def apply_nograd(name: str, fn: Callable, *inputs: Tensor):
     arrays = [t._array for t in inputs]
     out = fn(*arrays)
     return _wrap_outputs(out, None, False, op_name=name)
+
+
+def apply_with_cpu_fallback(apply_fn: Callable, name: str, fn: Callable,
+                            t: Tensor, supported: Callable[[], bool],
+                            complex_stays_on_cpu: bool = False):
+    """apply()/apply_nograd() with an eager CPU hop on backends missing a
+    capability (`supported()` False) — used by fft (no complex buffers on
+    the axon tunnel) and cpp_extension (no host callbacks there).
+
+    Concrete inputs move to the CPU backend around the op — inside
+    jax.default_device(cpu) so internal constants are created CPU-side —
+    and real results rejoin the accelerator (device_put transfers are
+    differentiable: jax transposes them, so gradients land back on the
+    original device). Under a jit trace there is no fallback: the op
+    lowers natively and an unsupported backend fails loudly rather than
+    silently degrading."""
+    if isinstance(t._array, jax.core.Tracer) or supported():
+        return apply_fn(name, fn, t)
+    try:
+        cpu = jax.devices("cpu")[0]
+    except Exception:  # no cpu plugin in this config: lower natively
+        return apply_fn(name, fn, t)
+    try:
+        dev = next(iter(t._array.devices()))
+    except Exception:
+        dev = None
+
+    def hop(a):
+        with jax.default_device(cpu):
+            out = fn(jax.device_put(a, cpu))
+        if dev is None or (complex_stays_on_cpu and
+                           jnp.issubdtype(out.dtype, jnp.complexfloating)):
+            # a backend without complex buffers can't take the result
+            # back; chained transforms keep working on CPU and rejoin at
+            # the first real-valued output
+            return out
+        return jax.device_put(out, dev)
+
+    return apply_fn(name, hop, t)

@@ -1,0 +1,5 @@
+"""paddle.utils analog: custom-op toolchain (cpp_extension) and model
+utilities."""
+from . import cpp_extension
+
+__all__ = ["cpp_extension"]

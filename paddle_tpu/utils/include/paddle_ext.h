@@ -1,0 +1,7 @@
+// paddle_tpu custom-op C ABI (get_include() ships this header).
+// Elementwise op:  PT_EXPORT void f(const T* x, T* y, int64_t n);
+// Its backward:    PT_EXPORT void f_grad(const T* x, const T* gy,
+//                                        T* gx, int64_t n);
+#pragma once
+#include <cstdint>
+#define PT_EXPORT extern "C"

@@ -293,7 +293,9 @@ class Parameter(Tensor):
     (python/paddle/fluid/framework.py Parameter). stop_gradient defaults
     False and it is persistable (enters state_dict)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer")
+    # _asp_mask: structured-sparsity mask (incubate.asp), carried by the
+    # param itself so masks stay scoped to their model
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "_asp_mask")
 
     def __init__(self, data, dtype=None, name: str = "", trainable: bool = True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)

@@ -7,7 +7,9 @@ import-path parity.
 from paddle_tpu.distributed.moe import MoELayer, switch_gating, top2_gating
 from paddle_tpu.nn import TransformerEncoderLayer as FusedTransformerLayer
 
-from . import distributed
+from . import asp, checkpoint, distributed, optimizer
+from .optimizer import LookAhead, ModelAverage
 
 __all__ = ["MoELayer", "top2_gating", "switch_gating",
-           "FusedTransformerLayer", "distributed"]
+           "FusedTransformerLayer", "distributed", "asp", "checkpoint",
+           "optimizer", "LookAhead", "ModelAverage"]

@@ -1,0 +1,120 @@
+"""ASP — automatic structured (n:m) sparsity, analog of
+python/paddle/incubate/asp/ (prune_model, decorate, calculate_density).
+
+TPU note: v5e has no sparse-math unit, so n:m sparsity here is a model
+-compression capability (mask + keep-masked-through-training), not a
+speedup; masks are enforced after every optimizer step by decorate()
+exactly like the reference's OptimizerWithSparsityGuarantee.
+
+State scoping: each pruned parameter carries its own mask
+(`param._asp_mask`) and exclusions live on the model
+(`model._asp_excluded`) — nothing is process-global, so independent
+models never interact and discarded models are garbage-collected.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.nn as nn
+
+__all__ = ["calculate_density", "create_mask", "check_mask_1d",
+           "prune_model", "decorate", "set_excluded_layers",
+           "reset_excluded_layers"]
+
+
+def calculate_density(mat) -> float:
+    a = np.asarray(mat)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def create_mask(weight, n=2, m=4) -> np.ndarray:
+    """n:m mask along the input (reduction) dim: within every group of m
+    consecutive weights, keep the n largest |w| (mask_1d algorithm).
+    A non-divisible trailing remainder (dim % m) stays dense."""
+    w = np.asarray(weight, np.float32)
+    if w.ndim < 2 or w.shape[0] < m:
+        return np.ones_like(w, np.float32)
+    main = (w.shape[0] // m) * m
+    flat = np.abs(w[:main]).reshape(main // m, m, -1)
+    order = np.argsort(flat, axis=1)
+    mask_main = np.ones_like(flat)
+    drop = order[:, : m - n, :]
+    np.put_along_axis(mask_main, drop, 0.0, axis=1)
+    mask = np.ones_like(w, np.float32)
+    mask[:main] = mask_main.reshape(main, *w.shape[1:])
+    return mask
+
+
+def check_mask_1d(mat, n=2, m=4) -> bool:
+    """True iff every complete m-group keeps at most n nonzeros (the
+    dense remainder of a non-divisible dim is ignored)."""
+    a = np.asarray(mat)
+    if a.ndim < 2 or a.shape[0] < m:
+        return False
+    main = (a.shape[0] // m) * m
+    nz = (np.abs(a[:main]).reshape(main // m, m, -1) > 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def set_excluded_layers(model, layer_names):
+    """Exclude named sublayers of THIS model from prune_model."""
+    excl = getattr(model, "_asp_excluded", None)
+    if excl is None:
+        object.__setattr__(model, "_asp_excluded", set())
+        excl = model._asp_excluded
+    excl.update(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is not None and hasattr(model, "_asp_excluded"):
+        model._asp_excluded.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Mask every Linear weight to n:m sparsity. Masks are recorded on
+    each pruned layer so a decorate()'d optimizer managing its params
+    re-applies them after every step. Returns {param_name: mask} for
+    the layers whose weights actually changed."""
+    import jax.numpy as jnp
+
+    if mask_algo not in ("mask_1d",):
+        raise NotImplementedError(f"mask_algo={mask_algo!r}; 'mask_1d' only")
+    excluded = getattr(model, "_asp_excluded", set())
+    out = {}
+    for name, sub in model.named_sublayers():
+        if name in excluded or not isinstance(sub, nn.Linear):
+            continue
+        w = sub.weight
+        mask = create_mask(np.asarray(w._array), n=n, m=m)
+        if not (mask == 0).any():
+            continue  # nothing prunable (e.g. dim < m): not "pruned"
+        w._array = (jnp.asarray(np.asarray(w._array, np.float32) * mask)
+                    .astype(w._array.dtype))
+        if with_mask:
+            w._asp_mask = mask  # decorate() reads this off the param
+        out[f"{name}.weight"] = mask
+    return out
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply pruning masks after the update
+    (OptimizerWithSparsityGuarantee analog). Only parameters managed by
+    THIS optimizer are re-masked."""
+    import jax.numpy as jnp
+
+    orig_step = optimizer.step
+
+    def step_with_masks(*a, **kw):
+        r = orig_step(*a, **kw)
+        # masks are read off the params lazily: prune_model may run
+        # before or after decorate
+        for p in optimizer._parameter_list:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._array = (jnp.asarray(
+                    np.asarray(p._array, np.float32) * mask)
+                    .astype(p._array.dtype))
+        return r
+
+    optimizer.step = step_with_masks
+    return optimizer

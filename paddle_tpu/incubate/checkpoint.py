@@ -1,0 +1,81 @@
+"""Auto-checkpoint — analog of
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py: epoch-range
+training that snapshots state on an interval and transparently resumes
+after a restart (the fault-tolerance story for long runs; pairs with
+the elastic launcher's pod restart).
+
+    for epoch in acp.train_epoch_range(10, save_dir="ckpt",
+                                       state={"model": m, "opt": opt}):
+        train_one_epoch(...)
+
+On restart the loop continues from the first incomplete epoch with
+model/optimizer state restored.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["train_epoch_range", "AutoCheckpointRange"]
+
+
+class AutoCheckpointRange:
+    def __init__(self, max_epoch_num, save_dir, state=None,
+                 save_checkpoint_inter=1, name="acp"):
+        self.max_epoch = int(max_epoch_num)
+        self.save_dir = save_dir
+        self.state = dict(state or {})
+        self.interval = max(int(save_checkpoint_inter), 1)
+        self.name = name
+        os.makedirs(save_dir, exist_ok=True)
+        self._meta_path = os.path.join(save_dir, f"{name}_meta.json")
+
+    def _load_meta(self):
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                return json.load(f)
+        return {"next_epoch": 0}
+
+    def _restore(self):
+        import paddle_tpu
+
+        for key, obj in self.state.items():
+            path = os.path.join(self.save_dir, f"{self.name}_{key}.pd")
+            if os.path.exists(path) and hasattr(obj, "set_state_dict"):
+                obj.set_state_dict(paddle_tpu.load(path))
+
+    def _snapshot(self, next_epoch):
+        import paddle_tpu
+
+        # every file lands via tmp + os.replace: a crash mid-save must
+        # never leave a torn state file behind a valid meta (the meta is
+        # replaced LAST, so it only ever points at complete snapshots)
+        for key, obj in self.state.items():
+            if hasattr(obj, "state_dict"):
+                path = os.path.join(self.save_dir, f"{self.name}_{key}.pd")
+                paddle_tpu.save(obj.state_dict(), path + ".tmp")
+                os.replace(path + ".tmp", path)
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"next_epoch": next_epoch, "time": time.time()}, f)
+        os.replace(tmp, self._meta_path)
+
+    def __iter__(self):
+        meta = self._load_meta()
+        start = int(meta.get("next_epoch", 0))
+        if start > 0:
+            self._restore()
+        for epoch in range(start, self.max_epoch):
+            yield epoch
+            # epoch completed: snapshot on the interval (and always on
+            # the final epoch so a finished run is fully recorded)
+            if (epoch + 1) % self.interval == 0 or \
+                    epoch + 1 == self.max_epoch:
+                self._snapshot(epoch + 1)
+
+
+def train_epoch_range(max_epoch_num, save_dir="auto_checkpoint",
+                      state=None, save_checkpoint_inter=1, name="acp"):
+    return AutoCheckpointRange(max_epoch_num, save_dir, state,
+                               save_checkpoint_inter, name)

@@ -1,0 +1,141 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage, analogs of
+python/paddle/incubate/optimizer/lookahead.py and modelaverage.py.
+
+LookAhead is expressed through the standard _single_update contract, so
+it composes with jit.TrainStep / DistributedTrainStep (the slow weights
+are just one more accumulator slot, conditionally synced with
+jnp.where on the step counter). ModelAverage is an eager-side EMA-style
+evaluation aid (apply/restore swap), matching the reference's usage.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead (Zhang et al. 2019): the inner optimizer moves
+    the fast weights; every k steps the slow weights interpolate toward
+    them (slow += alpha*(fast-slow)) and the fast weights reset to slow.
+
+        opt = LookAhead(paddle.optimizer.Adam(..., parameters=ps),
+                        alpha=0.5, k=5)
+    """
+
+    def __init__(self, inner_optimizer: Optimizer, alpha=0.5, k=5,
+                 name=None):
+        super().__init__(learning_rate=inner_optimizer._learning_rate,
+                         parameters=inner_optimizer._parameter_list,
+                         grad_clip=inner_optimizer._grad_clip)
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0,1], got {alpha}")
+        if int(k) < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    def _create_accumulators(self):
+        self.inner_optimizer._ensure_state()
+        accs = dict(self.inner_optimizer._accumulators)
+        # a real copy: slow weights must not alias the (donated) param
+        # buffers — `f(donate(a), donate(a))` is rejected by jax
+        accs["slow_param"] = [jnp.array(p._array, copy=True)
+                              for p in self._parameter_list]
+        return accs
+
+    def _per_param_extras(self, i):
+        return self.inner_optimizer._per_param_extras(i)
+
+    def _single_update(self, param, grad, accums, lr, step, extras=None):
+        inner_acc = {k: v for k, v in accums.items() if k != "slow_param"}
+        fast, new_acc = self.inner_optimizer._single_update(
+            param, grad, inner_acc, lr, step, extras=extras)
+        slow = accums["slow_param"]
+        sync = ((step + 1) % self.k) == 0
+        slow2 = jnp.where(sync,
+                          slow + self.alpha * (fast.astype(slow.dtype) - slow),
+                          slow)
+        fast2 = jnp.where(sync, slow2.astype(fast.dtype), fast)
+        out = dict(new_acc)
+        out["slow_param"] = slow2
+        return fast2, out
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters for evaluation
+    (modelaverage.py parity): call .step() after each optimizer.step();
+    evaluate inside `with ma.apply(): ...` (weights swapped to the
+    average), train again after restore.
+
+        ma = ModelAverage(0.15, parameters=model.parameters(),
+                          min_average_window=2, max_average_window=10)
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        zeros = lambda: [np.zeros_like(np.asarray(p._array, np.float32))
+                         for p in self._parameter_list]
+        # two-bucket rotation (the reference's sum_1/sum_2 scheme): the
+        # current bucket fills until the window cap, then rotates into
+        # `old`; the average always spans old+current, so a rotation
+        # halves the history instead of discarding it entirely
+        self._cur, self._old = zeros(), zeros()
+        self._cur_n = 0
+        self._old_n = 0
+        self._total = 0
+        self._backup = None
+
+    def _window(self):
+        """Effective window: rate*steps, clamped to [min,max] — the
+        documented knobs (modelaverage.py semantics)."""
+        return max(self.min_window,
+                   min(self.max_window,
+                       int(self._total * self.avg_rate) + 1))
+
+    def step(self):
+        if self._cur_n >= self._window():
+            self._old, self._cur = self._cur, self._old
+            self._old_n = self._cur_n
+            for s in self._cur:
+                s *= 0.0
+            self._cur_n = 0
+        for s, p in zip(self._cur, self._parameter_list):
+            s += np.asarray(p._array, np.float32)
+        self._cur_n += 1
+        self._total += 1
+
+    def _average(self):
+        n = self._cur_n + self._old_n
+        assert n > 0, "ModelAverage.step() never ran"
+        return [(c + o) / n for c, o in zip(self._cur, self._old)]
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        import jax
+
+        self._backup = [p._array for p in self._parameter_list]
+        for p, avg in zip(self._parameter_list, self._average()):
+            p._array = jnp.asarray(avg.astype(np.asarray(p._array).dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._parameter_list, self._backup):
+                p._array = b
+            self._backup = None

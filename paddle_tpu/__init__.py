@@ -101,6 +101,7 @@ from paddle_tpu import linalg  # noqa: E402
 from paddle_tpu import fft  # noqa: E402
 from paddle_tpu import utils  # noqa: E402
 from paddle_tpu import onnx  # noqa: E402
+from paddle_tpu import inference  # noqa: E402
 from paddle_tpu.hapi.dynamic_flops import flops  # noqa: E402
 from paddle_tpu.hapi import callbacks  # noqa: E402
 

@@ -154,7 +154,8 @@ def save(layer, path, input_spec=None, convert=None, **configs):
             # the Exported already holds the StableHLO — no second trace
             f.write(str(exported.mlir_module()))
         meta["input_spec"] = [
-            {"shape": list(s.shape), "dtype": str(s.dtype)} for s in input_spec
+            {"shape": list(s.shape), "dtype": str(s.dtype),
+             "name": getattr(s, "name", None)} for s in input_spec
         ]
         meta["state_names"] = state_names
         meta["has_mlir"] = True

@@ -1,0 +1,148 @@
+"""paddle.inference serving tier tests: Config/create_predictor over a
+saved artifact (AnalysisPredictor analog) and DistModel mesh-sharded
+micro-batch streaming (fleet_executor/dist_model.cc analog) — including
+mp=2 tensor-parallel serving parity on the virtual 8-device mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import inference
+from paddle_tpu.jit.api import InputSpec
+
+
+def _net(d=8, h=16, out=4):
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(d, h), nn.ReLU(), nn.Linear(h, out))
+
+
+def test_config_create_predictor_run(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8])])
+
+    cfg = inference.Config(path)
+    pred = inference.create_predictor(cfg)
+    x = np.random.RandomState(0).randn(5, 8).astype(np.float32)
+    (out,) = pred.run([x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_input_names(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], name="x")])
+    pred = inference.create_predictor(inference.Config(path))
+    assert pred.get_input_names() == ["x"]
+
+
+def test_dist_model_micro_batching_matches_full_batch():
+    net = _net()
+    cfg = inference.DistModelConfig(layer=net, dp=4, micro_batch_size=4)
+    dm = inference.DistModel(cfg).init()
+    x = np.random.RandomState(1).randn(16, 8).astype(np.float32)
+    (out,) = dm.run([x])
+    ref = net(paddle.to_tensor(x)).numpy()
+    assert out.shape == (16, 4)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dist_model_tensor_parallel_serving():
+    """mp=2 serving: ColumnParallel/RowParallel weights shard over the
+    mesh; output equals the single-device reference."""
+    from paddle_tpu.distributed import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+    from paddle_tpu.distributed.topology import (
+        HybridCommunicateGroup,
+        set_hybrid_communicate_group,
+    )
+
+    set_hybrid_communicate_group(HybridCommunicateGroup(dp=1, mp=2))
+    paddle.seed(0)
+
+    class MP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = ColumnParallelLinear(8, 32, gather_output=False)
+            self.fc2 = RowParallelLinear(32, 4, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.fc2(F.gelu(self.fc1(x)))
+
+    mp_net = MP()
+    x = np.random.RandomState(2).randn(6, 8).astype(np.float32)
+    ref = mp_net(paddle.to_tensor(x)).numpy()
+
+    dm = inference.DistModel(
+        inference.DistModelConfig(layer=mp_net, dp=1, mp=2,
+                                  micro_batch_size=3)).init()
+    (out,) = dm.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dist_model_from_saved_artifact(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8])])
+    dm = inference.DistModel(
+        inference.DistModelConfig(model_path=path,
+                                  micro_batch_size=2)).init()
+    x = np.random.RandomState(3).randn(6, 8).astype(np.float32)
+    (out,) = dm.run([x])
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dist_model_rejects_oversubscription():
+    with pytest.raises(ValueError, match="exceeds"):
+        inference.DistModel(
+            inference.DistModelConfig(layer=_net(), dp=64, mp=2)).init()
+
+
+def test_dist_model_pads_nondivisible_tail():
+    """Batch 18, dp=4, mbs=4: tail chunk of 2 pads to 4 and trims."""
+    net = _net()
+    dm = inference.DistModel(inference.DistModelConfig(
+        layer=net, dp=4, micro_batch_size=4)).init()
+    x = np.random.RandomState(5).randn(18, 8).astype(np.float32)
+    (out,) = dm.run([x])
+    assert out.shape == (18, 4)
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_micro_batch_streaming(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8])])
+    cfg = inference.Config(path)
+    cfg.set_micro_batch_size(4)
+    pred = inference.create_predictor(cfg)
+    x = np.random.RandomState(6).randn(10, 8).astype(np.float32)
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mixed_precision_requires_bf16_artifact(tmp_path):
+    net = _net()
+    path = str(tmp_path / "m32")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8])])
+    cfg = inference.Config(path)
+    cfg.enable_mixed_precision()
+    with pytest.raises(ValueError, match="bfloat16"):
+        inference.create_predictor(cfg)
+    # a convert='bfloat16' artifact passes the gate
+    path2 = str(tmp_path / "mbf")
+    paddle.jit.save(net, path2, input_spec=[InputSpec([None, 8])],
+                    convert="bfloat16")
+    cfg2 = inference.Config(path2)
+    cfg2.enable_mixed_precision()
+    pred = inference.create_predictor(cfg2)
+    (out,) = pred.run([np.zeros((2, 8), np.float32)])
+    assert out.shape == (2, 4)

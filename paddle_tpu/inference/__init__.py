@@ -24,6 +24,37 @@ __all__ = ["Config", "Predictor", "create_predictor", "DistModel",
            "DistModelConfig"]
 
 
+def _stream_micro_batches(forward, ins, mbs, pad_to=1):
+    """Shared serving loop: slice `ins` (list of batch-major arrays)
+    into micro-batches of `mbs`, pad each chunk to a multiple of
+    `pad_to` (dp sharding divisibility; padded rows trimmed after
+    readback), dispatch ALL chunks (jax async dispatch overlaps host
+    prep of chunk i+1 with device compute of chunk i), then gather into
+    per-output concatenated arrays."""
+    from paddle_tpu.ops.dispatch import unwrap
+
+    ins = [np.asarray(unwrap(i)) for i in ins]
+    B = ins[0].shape[0]
+    mbs = mbs or B
+    pending, tails = [], []
+    for lo in range(0, B, mbs):
+        chunk = [a[lo:lo + mbs] for a in ins]
+        n = chunk[0].shape[0]
+        pad = (-n) % max(pad_to, 1)
+        if pad:
+            chunk = [np.concatenate(
+                [c, np.repeat(c[-1:], pad, axis=0)], axis=0)
+                for c in chunk]
+        tails.append(n)
+        pending.append(forward(*chunk))
+    rows = []
+    for out, n in zip(pending, tails):
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        rows.append([np.asarray(unwrap(o))[:n] for o in outs])
+    return [np.concatenate([r[j] for r in rows], axis=0)
+            for j in range(len(rows[0]))]
+
+
 class Config:
     """AnalysisConfig analog. Minimal surface: model path prefix,
     mixed-precision toggle, micro-batching for DistModel."""
@@ -94,22 +125,8 @@ class Predictor:
         return [s.get("name") or f"x{i}" for i, s in enumerate(spec)]
 
     def run(self, inputs: Sequence):
-        mbs = self._config._micro_batch_size
-        B = np.asarray(inputs[0]).shape[0] if inputs else 0
-        if not mbs or mbs >= B:
-            outs = self._layer(*inputs)
-            outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            return [np.asarray(o._array if isinstance(o, Tensor) else o)
-                    for o in outs]
-        rows = []
-        for lo in range(0, B, mbs):
-            outs = self._layer(*[np.asarray(i)[lo:lo + mbs]
-                                 for i in inputs])
-            outs = outs if isinstance(outs, (list, tuple)) else [outs]
-            rows.append([np.asarray(
-                o._array if isinstance(o, Tensor) else o) for o in outs])
-        return [np.concatenate([r[j] for r in rows], axis=0)
-                for j in range(len(rows[0]))]
+        return _stream_micro_batches(self._layer, list(inputs),
+                                     self._config._micro_batch_size)
 
     __call__ = run
 
@@ -193,13 +210,14 @@ class DistModel:
             spec = param_pspec(p, self._hcg, sharding_stage=0)
             p._array = jax.device_put(p._array, NamedSharding(mesh, spec))
 
+        from paddle_tpu.ops.dispatch import unwrap
+
         def pure_fwd(param_arrays, buf_arrays, *xs):
             state = params + buffers
             with bound_state(
                     zip(state, list(param_arrays) + list(buf_arrays)),
                     state):
                 out = layer(*[Tensor._wrap(x) for x in xs])
-                unwrap = lambda t: t._array if isinstance(t, Tensor) else t
                 return jax.tree_util.tree_map(
                     unwrap, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
@@ -209,54 +227,30 @@ class DistModel:
             mesh, P("dp" if self._hcg.axis_size("dp") > 1 else None))
 
         def run_fwd(*xs):
-            arrs = [jax.device_put(np.asarray(
-                x._array if isinstance(x, Tensor) else x), batch_sharding)
-                for x in xs]
+            arrs = [jax.device_put(np.asarray(unwrap(x)), batch_sharding)
+                    for x in xs]
             return jitted([p._array for p in params],
                           [b._array for b in buffers], *arrs)
 
         self._forward = run_fwd
 
     def _run_translated(self, *xs):
-        out = self._translated(*xs)
-        unwrap = lambda t: t._array if isinstance(t, Tensor) else t
         import jax
 
+        from paddle_tpu.ops.dispatch import unwrap
+
+        out = self._translated(*xs)
         return jax.tree_util.tree_map(
             unwrap, out, is_leaf=lambda t: isinstance(t, Tensor))
 
     def run(self, inputs: Sequence):
-        """Serve one request batch: split into micro-batches, dispatch
-        them ALL (jax async dispatch pipelines host prep of batch i+1
-        with device compute of batch i — the interceptor-actor overlap,
-        minus the actors), then gather."""
+        """Serve one request batch (the interceptor-actor overlap, minus
+        the actors: see _stream_micro_batches)."""
         if self._forward is None:
             self.init()
-        ins = [np.asarray(i._array if isinstance(i, Tensor) else i)
-               for i in (inputs if isinstance(inputs, (list, tuple))
-                         else [inputs])]
-        B = ins[0].shape[0]
-        mbs = self.config.micro_batch_size or B
+        ins = list(inputs) if isinstance(inputs, (list, tuple)) \
+            else [inputs]
         dp = self._hcg.axis_size("dp") if self._hcg is not None else 1
-        pending = []
-        tails = []
-        for lo in range(0, B, mbs):
-            chunk = [a[lo:lo + mbs] for a in ins]
-            n = chunk[0].shape[0]
-            # pad the tail chunk so the dp batch sharding divides it;
-            # padded rows are sliced off after readback
-            pad = (-n) % max(dp, 1)
-            if pad:
-                chunk = [np.concatenate(
-                    [c, np.repeat(c[-1:], pad, axis=0)], axis=0)
-                    for c in chunk]
-            tails.append(n)
-            pending.append(self._forward(*chunk))  # async launch
-        # gather: readback blocks per micro-batch, in order
-        rows = []
-        for out, n in zip(pending, tails):
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            rows.append([np.asarray(o)[:n] for o in outs])
-        n_outs = len(rows[0])
-        return [np.concatenate([r[j] for r in rows], axis=0)
-                for j in range(n_outs)]
+        return _stream_micro_batches(self._forward, ins,
+                                     self.config.micro_batch_size,
+                                     pad_to=dp)

@@ -12,6 +12,8 @@ from .common import (
 )
 from .container import LayerDict, LayerList, ParameterList, Sequential
 from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
+from .rnn import (GRU, LSTM, RNN, GRUCell, LSTMCell, SimpleRNN,
+                  SimpleRNNCell)
 from .layer import Layer, ParamAttr
 from .loss import (
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss,

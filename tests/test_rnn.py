@@ -1,0 +1,204 @@
+"""RNN family tests (reference python/paddle/nn/layer/rnn.py): cells vs
+numpy recurrence, stacked/bidirectional LSTM/GRU/SimpleRNN, sequence
+masking, gradients, and jit compatibility.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _np_lstm_step(wih, whh, bih, bhh, x, h, c):
+    g = x @ wih.T + bih + h @ whh.T + bhh
+    i, f, gg, o = np.split(g, 4, axis=-1)
+    sig = lambda a: 1 / (1 + np.exp(-a))
+    i, f, o = sig(i), sig(f), sig(o)
+    c2 = f * c + i * np.tanh(gg)
+    h2 = o * np.tanh(c2)
+    return h2, c2
+
+
+def _np_gru_step(wih, whh, bih, bhh, x, h):
+    sig = lambda a: 1 / (1 + np.exp(-a))
+    xg = x @ wih.T + bih
+    hg = h @ whh.T + bhh
+    xr, xz, xc = np.split(xg, 3, axis=-1)
+    hr, hz, hc = np.split(hg, 3, axis=-1)
+    r, z = sig(xr + hr), sig(xz + hz)
+    c = np.tanh(xc + r * hc)
+    return (1 - z) * c + z * h
+
+
+def _cell_weights(cell):
+    return [np.asarray(p._array) for p in cell._params()]
+
+
+def test_lstm_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.LSTMCell(8, 16)
+    x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    out, (h, c) = cell(paddle.to_tensor(x))
+    wih, whh, bih, bhh = _cell_weights(cell)
+    h_ref, c_ref = _np_lstm_step(wih, whh, bih, bhh, x,
+                                 np.zeros((4, 16), np.float32),
+                                 np.zeros((4, 16), np.float32))
+    np.testing.assert_allclose(np.asarray(out._array), h_ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c._array), c_ref, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.GRUCell(8, 16)
+    x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    out, h = cell(paddle.to_tensor(x))
+    wih, whh, bih, bhh = _cell_weights(cell)
+    ref = _np_gru_step(wih, whh, bih, bhh, x,
+                       np.zeros((4, 16), np.float32))
+    np.testing.assert_allclose(np.asarray(out._array), ref, atol=1e-5)
+
+
+def test_simple_rnn_cell_and_rnn_wrapper():
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(8, 16)
+    rnn = nn.RNN(cell)
+    x = np.random.RandomState(2).randn(4, 5, 8).astype(np.float32)
+    outs, final = rnn(paddle.to_tensor(x))
+    assert outs.shape == [4, 5, 16]
+    wih, whh, bih, bhh = _cell_weights(cell)
+    h = np.zeros((4, 16), np.float32)
+    for t in range(5):
+        h = np.tanh(x[:, t] @ wih.T + bih + h @ whh.T + bhh)
+    np.testing.assert_allclose(np.asarray(outs._array)[:, -1], h,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(final._array), h, atol=1e-5)
+
+
+def test_lstm_full_sequence_matches_numpy():
+    paddle.seed(0)
+    lstm = nn.LSTM(8, 16)
+    x = np.random.RandomState(3).randn(2, 6, 8).astype(np.float32)
+    outs, (hN, cN) = lstm(paddle.to_tensor(x))
+    wih, whh, bih, bhh = _cell_weights(lstm.cell_0_0)
+    h = c = np.zeros((2, 16), np.float32)
+    refs = []
+    for t in range(6):
+        h, c = _np_lstm_step(wih, whh, bih, bhh, x[:, t], h, c)
+        refs.append(h)
+    np.testing.assert_allclose(np.asarray(outs._array),
+                               np.stack(refs, 1), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hN._array)[0], h, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cN._array)[0], c, atol=1e-5)
+
+
+def test_bidirectional_gru_shapes_and_reverse_semantics():
+    paddle.seed(0)
+    gru = nn.GRU(8, 16, direction="bidirect")
+    x = np.random.RandomState(4).randn(3, 5, 8).astype(np.float32)
+    outs, hN = gru(paddle.to_tensor(x))
+    assert outs.shape == [3, 5, 32]  # fwd+bwd concat
+    assert hN.shape == [2, 3, 16]   # L*ndir
+    # stacked-bidirect shape check
+    gru2 = nn.GRU(8, 16, num_layers=2, direction="bidirect")
+    o2, h2 = gru2(paddle.to_tensor(x))
+    assert o2.shape == [3, 5, 32] and h2.shape == [4, 3, 16]
+    # the backward direction's output at t=0 must depend on the LAST
+    # input step (reverse recurrence)
+    x2 = x.copy()
+    x2[:, -1] += 1.0
+    outs2, _ = gru(paddle.to_tensor(x2))
+    d = np.abs(np.asarray(outs2._array) - np.asarray(outs._array))
+    assert d[:, 0, 16:].max() > 1e-6   # bwd out at t=0 changed
+    assert d[:, 0, :16].max() < 1e-7   # fwd out at t=0 unchanged
+
+
+def test_sequence_length_masks_final_state():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8)
+    x = np.random.RandomState(5).randn(2, 6, 4).astype(np.float32)
+    seq = np.array([3, 6], np.int64)
+    outs, (hN, _) = lstm(paddle.to_tensor(x),
+                         sequence_length=paddle.to_tensor(seq))
+    # sample 0's final state == running only its first 3 steps
+    outs3, (h3, _) = lstm(paddle.to_tensor(x[:, :3]))
+    np.testing.assert_allclose(np.asarray(hN._array)[0, 0],
+                               np.asarray(h3._array)[0, 0], atol=1e-5)
+    # padded steps emit zeros
+    np.testing.assert_allclose(np.asarray(outs._array)[0, 3:], 0.0)
+
+
+def test_rnn_gradients_flow():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.to_tensor(
+        np.random.RandomState(6).randn(2, 5, 4).astype(np.float32))
+    x.stop_gradient = False
+    outs, _ = lstm(x)
+    outs.sum().backward()
+    assert x.grad is not None
+    for p in lstm.parameters():
+        assert p.grad is not None, "every cell weight gets a gradient"
+
+
+def test_lstm_trains_under_trainstep():
+    from paddle_tpu.jit import TrainStep
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lstm = nn.LSTM(4, 16)
+            self.head = nn.Linear(16, 2)
+
+        def forward(self, x):
+            outs, (h, _) = self.lstm(x)
+            return self.head(h[0])
+
+    net = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    step = TrainStep(net, opt, F.cross_entropy)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8, 4).astype(np.float32)
+    y = (x.sum(axis=(1, 2)) > 0).astype(np.int64)
+    losses = [float(step(paddle.to_tensor(x), label=paddle.to_tensor(y)))
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_time_major_layout():
+    paddle.seed(0)
+    gru = nn.GRU(4, 8, time_major=True)
+    x = np.random.RandomState(7).randn(5, 3, 4).astype(np.float32)  # [T,B,I]
+    outs, _ = gru(paddle.to_tensor(x))
+    assert outs.shape == [5, 3, 8]
+    paddle.seed(0)
+    gru2 = nn.GRU(4, 8, time_major=False)
+    outs2, _ = gru2(paddle.to_tensor(np.swapaxes(x, 0, 1)))
+    np.testing.assert_allclose(np.asarray(outs._array),
+                               np.swapaxes(np.asarray(outs2._array), 0, 1),
+                               atol=1e-6)
+
+
+def test_learnable_initial_state_gets_gradient():
+    from paddle_tpu.core.tensor import Parameter
+
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8)
+    h0 = Parameter(np.zeros((1, 2, 8), np.float32))
+    c0 = Parameter(np.zeros((1, 2, 8), np.float32))
+    x = paddle.to_tensor(
+        np.random.RandomState(8).randn(2, 5, 4).astype(np.float32))
+    outs, _ = lstm(x, initial_states=(h0, c0))
+    outs.sum().backward()
+    assert h0.grad is not None and c0.grad is not None
+    assert float(np.abs(np.asarray(h0.grad._array)).sum()) > 0
+    # cell-level learnable state too
+    cell = nn.GRUCell(4, 8)
+    s0 = Parameter(np.zeros((2, 8), np.float32))
+    out, _ = cell(paddle.to_tensor(
+        np.random.RandomState(9).randn(2, 4).astype(np.float32)), s0)
+    out.sum().backward()
+    assert s0.grad is not None

@@ -1,0 +1,85 @@
+"""MobileNetV2 — analog of python/paddle/vision/models/mobilenetv2.py
+(inverted residuals, Sandler et al. 2018). Depthwise convs lower to
+grouped lax convs; trains through jit.TrainStep in bf16."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["MobileNetV2", "mobilenet_v2"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def _conv_bn(cin, cout, k, stride=1, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=(k - 1) // 2,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(cout),
+        nn.ReLU6(),
+    )
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        steps = []
+        if expand_ratio != 1:
+            steps.append(_conv_bn(cin, hidden, 1))
+        steps.append(_conv_bn(hidden, hidden, 3, stride, groups=hidden))
+        steps.append(nn.Conv2D(hidden, cout, 1, bias_attr=False))
+        steps.append(nn.BatchNorm2D(cout))
+        self.conv = nn.Sequential(*steps)
+
+    def forward(self, x):
+        y = self.conv(x)
+        return x + y if self.use_res else y
+
+
+class MobileNetV2(nn.Layer):
+    # t (expansion), c (channels), n (repeats), s (first stride)
+    CFG = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+           (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cin = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        feats = [_conv_bn(3, cin, 3, stride=2)]
+        for t, c, n, s in self.CFG:
+            cout = _make_divisible(c * scale)
+            for i in range(n):
+                feats.append(InvertedResidual(cin, cout,
+                                              s if i == 0 else 1, t))
+                cin = cout
+        feats.append(_conv_bn(cin, last, 1))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool2d_avg = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(last, num_classes))
+        self._last = last
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool2d_avg(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape([x.shape[0], -1]))
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return MobileNetV2(scale=scale, **kwargs)

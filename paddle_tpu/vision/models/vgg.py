@@ -1,0 +1,112 @@
+"""VGG + AlexNet — analogs of python/paddle/vision/models/vgg.py and
+alexnet.py (classic conv stacks; the MXU eats these whole)."""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "AlexNet",
+           "alexnet"]
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _vgg_features(cfg, batch_norm):
+    steps, cin = [], 3
+    for v in cfg:
+        if v == "M":
+            steps.append(nn.MaxPool2D(kernel_size=2, stride=2))
+            continue
+        steps.append(nn.Conv2D(cin, v, 3, padding=1))
+        if batch_norm:
+            steps.append(nn.BatchNorm2D(v))
+        steps.append(nn.ReLU())
+        cin = v
+    return nn.Sequential(*steps)
+
+
+class VGG(nn.Layer):
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape([x.shape[0], -1]))
+        return x
+
+
+def _vgg(cfg, batch_norm, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return VGG(_vgg_features(_VGG_CFGS[cfg], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kw):
+    return _vgg("A", batch_norm, pretrained, **kw)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kw):
+    return _vgg("B", batch_norm, pretrained, **kw)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kw):
+    return _vgg("D", batch_norm, pretrained, **kw)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kw):
+    return _vgg("E", batch_norm, pretrained, **kw)
+
+
+class AlexNet(nn.Layer):
+    """alexnet.py analog (the 2012 stack, modern single-GPU layout)."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2),
+        )
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.reshape([x.shape[0], -1]))
+        return x
+
+
+def alexnet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return AlexNet(**kwargs)

@@ -23,7 +23,8 @@ import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["box_iou", "nms", "multiclass_nms", "nms_fixed"]
+__all__ = ["box_iou", "nms", "multiclass_nms", "nms_fixed",
+           "roi_align", "deform_conv2d", "box_coder"]
 
 
 def _arr(x):
@@ -155,3 +156,237 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
     order = np.argsort(-out[:, 1])
     out = out[order]
     return Tensor._wrap(jnp.asarray(out)), len(out)
+
+
+# ---------------------------------------------------------------------------
+# RoI / deformable ops (detection model zoo tier)
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(feat, ys, xs):
+    """feat [C,H,W], ys/xs [P] float coords -> [C,P]. Out-of-bounds
+    samples contribute 0 (roi_align border semantics)."""
+    C, H, W = feat.shape
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1 - wx1), (1, wx1)):
+            yy = (y0 + dy).astype(jnp.int32)
+            xx = (x0 + dx).astype(jnp.int32)
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1)
+            xc = jnp.clip(xx, 0, W - 1)
+            v = feat[:, yc, xc]  # [C,P] gather
+            out = out + v * (wy * wx * valid)[None, :]
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (python/paddle/vision/ops.py roi_align; kernel
+    phi/kernels/gpu/roi_align_kernel.cu). x [B,C,H,W] NCHW, boxes
+    [K,4] (x1,y1,x2,y2), boxes_num [B]. Returns [K,C,ph,pw].
+
+    TPU-native: fully vectorized — per-roi sample grids, one batched
+    bilinear gather vmapped over rois; sampling_ratio<=0 resolves to 2
+    (static shapes; the reference's adaptive ceil(roi/bin) is
+    data-dependent and cannot be a static shape)."""
+    from paddle_tpu.ops.dispatch import apply, as_tensor
+
+    ba = _arr(boxes).astype(jnp.float32)
+    bn = _arr(boxes_num).astype(jnp.int32)
+    ph, pw = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+    s = 2 if sampling_ratio is None or sampling_ratio <= 0 \
+        else int(sampling_ratio)
+    K = ba.shape[0]
+    # roi k belongs to image searchsorted(cumsum(bn), k, 'right')
+    batch_of = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(K), side="right")
+    off = 0.5 if aligned else 0.0
+
+    def fn(xarr):
+        xf = xarr.astype(jnp.float32)
+
+        def one_roi(box, bidx):
+            x1, y1, x2, y2 = box * spatial_scale
+            x1, y1 = x1 - off, y1 - off
+            x2, y2 = x2 - off, y2 - off
+            rw = x2 - x1
+            rh = y2 - y1
+            if not aligned:
+                rw = jnp.maximum(rw, 1.0)
+                rh = jnp.maximum(rh, 1.0)
+            bw = rw / pw
+            bh = rh / ph
+            # sample grid: (ph*s, pw*s) points, s per bin per axis
+            gy = y1 + (jnp.arange(ph * s) + 0.5) * \
+                (bh / s).astype(jnp.float32)
+            gx = x1 + (jnp.arange(pw * s) + 0.5) * \
+                (bw / s).astype(jnp.float32)
+            yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+            vals = _bilinear_sample(xf[bidx], yy.ravel(), xx.ravel())
+            C = vals.shape[0]
+            # average the s*s samples of each bin
+            return vals.reshape(C, ph, s, pw, s).mean(axis=(2, 4))
+
+        return jax.vmap(one_roi)(ba, batch_of).astype(xarr.dtype)
+
+    # gradients flow to x (bilinear sampling is piecewise-linear);
+    # boxes/boxes_num are data, not differentiable inputs
+    return apply("roi_align", fn, as_tensor(x))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (python/paddle/vision/ops.py deform_conv2d;
+    kernel phi/kernels/gpu/deformable_conv_kernel.cu). x [B,Cin,H,W],
+    offset [B, 2*dg*kh*kw, Ho, Wo] (y,x interleaved per tap), mask
+    [B, dg*kh*kw, Ho, Wo] for v2. Returns [B,Cout,Ho,Wo].
+
+    TPU-native: gather-based — sample every (tap, output-position) by
+    bilinear interpolation (one big vmapped gather), then contract taps
+    x channels with the weight in a single einsum on the MXU (the
+    im2col-with-offsets formulation). Differentiable in x, offset,
+    weight, mask, and bias (routed through the op tape)."""
+    from paddle_tpu.ops.dispatch import apply, as_tensor
+
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    diff_in = [as_tensor(x), as_tensor(offset), as_tensor(weight)]
+    has_mask = mask is not None
+    if has_mask:
+        diff_in.append(as_tensor(mask))
+    has_bias = bias is not None
+    if has_bias:
+        diff_in.append(as_tensor(bias))
+
+    def fn(*arrs):
+        return _deform_conv2d_impl(arrs, has_mask, has_bias, st, pd, dl,
+                                   deformable_groups, groups)
+
+    return apply("deform_conv2d", fn, *diff_in)
+
+
+def _deform_conv2d_impl(arrs, has_mask, has_bias, st, pd, dl,
+                        deformable_groups, groups):
+    it = iter(arrs)
+    xin = next(it)
+    xa = xin.astype(jnp.float32)
+    oa = next(it).astype(jnp.float32)
+    wa = next(it).astype(jnp.float32)
+    ma = next(it).astype(jnp.float32) if has_mask else None
+    bia = next(it) if has_bias else None
+    B, Cin, H, W = xa.shape
+    Cout, Cin_g, kh, kw = wa.shape
+    Ho = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+    Wo = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+    dg = deformable_groups
+    if groups != 1:
+        raise NotImplementedError("deform_conv2d: groups>1 not supported")
+    if dg != 1 and Cin % dg:
+        raise ValueError("Cin not divisible by deformable_groups")
+
+    # base sampling positions per output pixel and tap
+    oy = jnp.arange(Ho) * st[0] - pd[0]
+    ox = jnp.arange(Wo) * st[1] - pd[1]
+    ky = jnp.arange(kh) * dl[0]
+    kx = jnp.arange(kw) * dl[1]
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # Ho,1,kh,1
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # 1,Wo,1,kw
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).astype(jnp.float32)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).astype(jnp.float32)
+
+    off_r = oa.reshape(B, dg, kh * kw, 2, Ho, Wo)
+    dy = jnp.moveaxis(off_r[:, :, :, 0], (2,), (4,)) \
+        .reshape(B, dg, Ho, Wo, kh * kw)
+    dx = jnp.moveaxis(off_r[:, :, :, 1], (2,), (4,)) \
+        .reshape(B, dg, Ho, Wo, kh * kw)
+    sy = base_y.reshape(Ho, Wo, kh * kw)[None, None] + dy
+    sx = base_x.reshape(Ho, Wo, kh * kw)[None, None] + dx  # B,dg,Ho,Wo,T
+
+    cg = Cin // dg
+
+    def sample_img(feat_g, ys, xs):
+        # feat_g [cg,H,W]; ys/xs [Ho,Wo,T]
+        return _bilinear_sample(feat_g, ys.ravel(), xs.ravel()) \
+            .reshape(cg, Ho, Wo, kh * kw)
+
+    def per_batch(feat, ys, xs, mk):
+        # feat [Cin,H,W] -> [dg,cg,H,W]; ys/xs [dg,Ho,Wo,T]
+        fg = feat.reshape(dg, cg, H, W)
+        vals = jax.vmap(sample_img)(fg, ys, xs)  # [dg,cg,Ho,Wo,T]
+        if mk is not None:
+            vals = vals * mk.reshape(dg, kh * kw, Ho, Wo) \
+                .transpose(0, 2, 3, 1)[:, None]
+        return vals.reshape(Cin, Ho, Wo, kh * kw)
+
+    if ma is None:
+        vals = jax.vmap(lambda f, ys, xs: per_batch(f, ys, xs, None))(
+            xa, sy, sx)
+    else:
+        vals = jax.vmap(per_batch)(xa, sy, sx, ma)
+    # contract (Cin, taps) with weight on the MXU
+    wflat = wa.reshape(Cout, Cin, kh * kw)
+    out = jnp.einsum("bchwt,oct->bohw", vals, wflat)
+    if bia is not None:
+        out = out + bia.astype(out.dtype).reshape(1, -1, 1, 1)
+    return out.astype(xin.dtype)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode detection boxes against priors
+    (python/paddle/vision/ops.py box_coder; phi box_coder kernel).
+    encode: [T,4] targets vs [P,4] priors -> [T,P,4] offsets;
+    decode: [T,P,4] (or broadcastable) offsets -> boxes."""
+    pb = _arr(prior_box).astype(jnp.float32)
+    tb = _arr(target_box).astype(jnp.float32)
+    pv = None if prior_box_var is None else \
+        _arr(prior_box_var).astype(jnp.float32)
+    pw = pb[:, 2] - pb[:, 0] + (0.0 if box_normalized else 1.0)
+    ph = pb[:, 3] - pb[:, 1] + (0.0 if box_normalized else 1.0)
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + (0.0 if box_normalized else 1.0)
+        th = tb[:, 3] - tb[:, 1] + (0.0 if box_normalized else 1.0)
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None]) / pw[None]
+        oy = (tcy[:, None] - pcy[None]) / ph[None]
+        ow = jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10))
+        oh = jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pv is not None:
+            out = out / pv[None]
+        return Tensor._wrap(out)
+    if code_type == "decode_center_size":
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        # variance broadcasts along the prior axis (dim 1 for axis=0,
+        # dim 0 for axis=1), like the center/size terms below
+        if pv is not None:
+            o = tb * (pv[None] if axis == 0 else pv[:, None])
+        else:
+            o = tb
+        if axis == 0:
+            cw, ch, ccx, ccy = pw[None], ph[None], pcx[None], pcy[None]
+        else:
+            cw, ch, ccx, ccy = pw[:, None], ph[:, None], pcx[:, None], \
+                pcy[:, None]
+        dcx = o[..., 0] * cw + ccx
+        dcy = o[..., 1] * ch + ccy
+        dw = jnp.exp(o[..., 2]) * cw
+        dh = jnp.exp(o[..., 3]) * ch
+        sub = 0.0 if box_normalized else 1.0
+        out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                         dcx + dw * 0.5 - sub, dcy + dh * 0.5 - sub],
+                        axis=-1)
+        return Tensor._wrap(jnp.squeeze(out, 1) if out.shape[1] == 1
+                            and _arr(target_box).ndim == 2 else out)
+    raise ValueError(f"unknown code_type {code_type!r}")

@@ -1,0 +1,177 @@
+"""Vision detection ops (roi_align/deform_conv2d/box_coder, reference
+python/paddle/vision/ops.py) and the MobileNetV2/VGG/AlexNet model
+families (python/paddle/vision/models/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops
+from paddle_tpu.vision.models import (
+    AlexNet,
+    MobileNetV2,
+    alexnet,
+    mobilenet_v2,
+    vgg11,
+    vgg16,
+)
+
+
+# -- roi_align -----------------------------------------------------------
+def test_roi_align_constant_feature_is_exact():
+    """On a constant feature map every bilinear sample equals the
+    constant, whatever the box."""
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    boxes = np.array([[0.7, 1.3, 5.2, 6.9]], np.float32)
+    out = ops.roi_align(x, boxes, np.array([1]), output_size=3)
+    assert out.shape == [1, 2, 3, 3]
+    np.testing.assert_allclose(out.numpy(), 3.5, rtol=1e-6)
+
+
+def test_roi_align_linear_ramp():
+    """On f(y,x) = x the bin average equals the bin-center x coord."""
+    W = 16
+    ramp = np.tile(np.arange(W, dtype=np.float32), (W, 1))
+    x = ramp[None, None]
+    boxes = np.array([[2.0, 2.0, 10.0, 10.0]], np.float32)
+    out = ops.roi_align(x, boxes, np.array([1]), output_size=2,
+                        aligned=False)
+    # box width 8, 2 bins of 4: centers at x=4 and x=8 -> sampled at
+    # pixel centers (continuous coords minus the .5 alignment)
+    v = out.numpy()[0, 0]
+    assert v[0, 0] < v[0, 1]
+    np.testing.assert_allclose(v[:, 1] - v[:, 0], 4.0, atol=1e-4)
+
+
+def test_roi_align_batch_routing():
+    """boxes_num routes rois to the right image."""
+    x = np.zeros((2, 1, 4, 4), np.float32)
+    x[0] = 1.0
+    x[1] = 2.0
+    boxes = np.array([[0, 0, 3, 3]] * 3, np.float32)
+    out = ops.roi_align(x, boxes, np.array([2, 1]), output_size=1)
+    np.testing.assert_allclose(out.numpy().ravel(), [1, 1, 2], rtol=1e-6)
+
+
+# -- deform_conv2d -------------------------------------------------------
+def test_deform_conv_zero_offset_equals_conv():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out = ops.deform_conv2d(x, off, w, padding=1)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv_integer_shift():
+    """A +1-pixel x-offset on every tap equals convolving the shifted
+    image (interior pixels)."""
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 1, 10, 10).astype(np.float32)
+    w = rs.randn(1, 1, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 10, 10), np.float32)
+    off[:, 1::2] = 1.0  # dx = +1 on every tap
+    out = ops.deform_conv2d(x, off, w, padding=1).numpy()
+    x_shift = np.roll(x, -1, axis=3)
+    ref = ops.deform_conv2d(x_shift, np.zeros_like(off), w,
+                            padding=1).numpy()
+    np.testing.assert_allclose(out[..., 2:-2, 2:-2], ref[..., 2:-2, 2:-2],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_v2_mask():
+    """mask=0 kills the output entirely; mask=1 matches v1."""
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 2, 6, 6).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    out0 = ops.deform_conv2d(x, off, w, padding=1,
+                             mask=np.zeros((1, 9, 6, 6), np.float32))
+    np.testing.assert_allclose(out0.numpy(), 0.0, atol=1e-6)
+    out1 = ops.deform_conv2d(x, off, w, padding=1,
+                             mask=np.ones((1, 9, 6, 6), np.float32))
+    ref = ops.deform_conv2d(x, off, w, padding=1)
+    np.testing.assert_allclose(out1.numpy(), ref.numpy(), rtol=1e-5)
+
+
+# -- box_coder -----------------------------------------------------------
+def test_box_coder_encode_decode_roundtrip():
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    targets = np.array([[1, 1, 5, 5], [0, 0, 6, 6]], np.float32)
+    enc = ops.box_coder(priors, var, targets).numpy()  # [T,P,4]
+    assert enc.shape == (2, 2, 4)
+    dec = ops.box_coder(priors, var, enc,
+                        code_type="decode_center_size").numpy()
+    # decoding each target's encoding against its prior recovers it
+    for t in range(2):
+        np.testing.assert_allclose(dec[t, t], targets[t], atol=1e-4)
+
+
+# -- model families ------------------------------------------------------
+@pytest.mark.parametrize("ctor,kw,feat", [
+    (mobilenet_v2, {"num_classes": 10}, None),
+    (mobilenet_v2, {"num_classes": 10, "scale": 0.5}, None),
+    (vgg11, {"num_classes": 10}, None),
+    (alexnet, {"num_classes": 10}, None),
+])
+def test_model_families_forward(ctor, kw, feat):
+    paddle.seed(0)
+    m = ctor(**kw)
+    m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 224, 224).astype(np.float32))
+    out = m(x)
+    assert out.shape == [2, 10]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_mobilenet_trains():
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    m = mobilenet_v2(num_classes=4, scale=0.25)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=m.parameters())
+    step = TrainStep(m, opt, F.cross_entropy)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 4, (8,))
+    losses = [float(step(paddle.to_tensor(x), label=paddle.to_tensor(y)))
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_vgg16_structure():
+    m = vgg16(num_classes=10)
+    convs = [l for _, l in m.named_sublayers()
+             if isinstance(l, nn.Conv2D)]
+    assert len(convs) == 13  # the "16" = 13 conv + 3 fc
+
+
+def test_roi_align_and_deform_conv_are_differentiable():
+    rs = np.random.RandomState(3)
+    x = paddle.to_tensor(rs.randn(1, 2, 8, 8).astype(np.float32))
+    x.stop_gradient = False
+    out = ops.roi_align(x, np.array([[1, 1, 6, 6]], np.float32),
+                        np.array([1]), output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and \
+        float(np.abs(np.asarray(x.grad._array)).sum()) > 0
+
+    from paddle_tpu.core.tensor import Parameter
+
+    x2 = paddle.to_tensor(rs.randn(1, 2, 6, 6).astype(np.float32))
+    x2.stop_gradient = False
+    w = Parameter(rs.randn(3, 2, 3, 3).astype(np.float32))
+    off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+    off.stop_gradient = False
+    b = Parameter(rs.randn(3).astype(np.float32))
+    out2 = ops.deform_conv2d(x2, off, w, bias=b, padding=1)
+    out2.sum().backward()
+    for t in (x2, w, b, off):
+        assert t.grad is not None, f"no grad for {t}"

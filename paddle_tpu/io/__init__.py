@@ -1,6 +1,7 @@
 from .dataloader import DataLoader
 from .dataset import (
     ChainDataset,
+    ConcatDataset,
     ComposeDataset,
     Dataset,
     IterableDataset,
@@ -21,7 +22,7 @@ from .native import NativeArrayLoader, native_available
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-    "ChainDataset", "Subset", "random_split", "DataLoader", "BatchSampler",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split", "DataLoader", "BatchSampler",
     "DistributedBatchSampler", "Sampler", "RandomSampler", "SequenceSampler",
     "WeightedRandomSampler", "NativeArrayLoader", "native_available",
 ]

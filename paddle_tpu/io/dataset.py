@@ -84,3 +84,31 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[off:off + ln].tolist()))
         off += ln
     return out
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of datasets (python/paddle/io/ ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert self.datasets, "datasets should not be empty"
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        import bisect
+
+        if idx < 0:
+            if idx < -len(self):
+                raise ValueError(
+                    f"index {idx} out of range for length {len(self)}")
+            idx += len(self)
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = 0 if di == 0 else self.cumulative_sizes[di - 1]
+        return self.datasets[di][idx - prev]

@@ -14,6 +14,9 @@ from .container import LayerDict, LayerList, ParameterList, Sequential
 from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D
 from .rnn import (GRU, LSTM, RNN, GRUCell, LSTMCell, SimpleRNN,
                   SimpleRNNCell)
+from .pooling import MaxPool3D, AvgPool3D  # noqa: F401  (3-D pools)
+from .common import Fold, Unfold  # noqa: F401
+from .norm import SpectralNorm  # noqa: F401
 from .layer import Layer, ParamAttr
 from .loss import (
     BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss,

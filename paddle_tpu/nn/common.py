@@ -137,3 +137,32 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return nn_ops.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class Unfold(Layer):
+    """im2col layer (python/paddle/nn/layer/common.py Unfold)."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes, self.strides = kernel_sizes, strides
+        self.paddings, self.dilations = paddings, dilations
+
+    def forward(self, x):
+        return nn_ops.unfold(x, self.kernel_sizes, self.strides,
+                             self.paddings, self.dilations)
+
+
+class Fold(Layer):
+    """col2im layer (common.py Fold) — the exact adjoint of Unfold."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings, self.dilations = \
+            strides, paddings, dilations
+
+    def forward(self, x):
+        return nn_ops.fold(x, self.output_sizes, self.kernel_sizes,
+                           self.strides, self.paddings, self.dilations)

@@ -161,3 +161,72 @@ class LocalResponseNorm(Layer):
             return a / jnp.power(k + alpha * summed, beta)
 
         return apply("lrn", fn, x)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization (python/paddle/nn/layer/norm.py
+    SpectralNorm; phi spectral_norm kernel): returns weight / sigma_max,
+    sigma estimated by power iteration. The u/v vectors persist as
+    buffers and advance power_iters steps per forward (train mode),
+    matching the reference's in-forward iteration."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from paddle_tpu.core import random as prandom
+
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        k1, k2 = jax.random.split(prandom.next_key())
+        u = jax.random.normal(k1, (h,), jnp.float32)
+        v = jax.random.normal(k2, (w,), jnp.float32)
+        self.register_buffer("weight_u", Tensor(u / jnp.linalg.norm(u)))
+        self.register_buffer("weight_v", Tensor(v / jnp.linalg.norm(v)))
+
+    def forward(self, weight):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.dispatch import apply, as_tensor
+
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+        # the reference's spectral_norm op runs power_iters EVERY
+        # forward (train and eval) — u/v from init are random, so
+        # skipping iteration would divide by a meaningless sigma
+        do_iter = True
+
+        def fn(w, u, v):
+            perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+            m = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            uu, vv = u, v
+            if do_iter:
+                for _ in range(iters):
+                    vv = m.T @ uu
+                    vv = vv / (jnp.linalg.norm(vv) + eps)
+                    uu = m @ vv
+                    uu = uu / (jnp.linalg.norm(uu) + eps)
+            # power-iteration state is an estimate, not a differentiable
+            # path (reference stops gradients through u/v)
+            uu = jax.lax.stop_gradient(uu)
+            vv = jax.lax.stop_gradient(vv)
+            sigma = uu @ (m @ vv)
+            return w / sigma, uu, vv
+
+        out, u2, v2 = apply("spectral_norm", fn, as_tensor(weight),
+                            self.weight_u, self.weight_v)
+        # persist the advanced power-iteration state (buffers); under a
+        # jit trace the arrays are tracers — state then rides the
+        # compiled step's buffer plumbing instead. Only train mode
+        # advances the stored state (eval iterates from it but leaves
+        # it untouched, so eval is idempotent).
+        if self.training and not isinstance(u2._array, jax.core.Tracer):
+            self.weight_u._array = u2._array
+            self.weight_v._array = v2._array
+        return out

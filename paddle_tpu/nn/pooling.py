@@ -71,3 +71,38 @@ class AdaptiveMaxPool2D(Layer):
 
     def forward(self, x):
         return nn_ops.adaptive_max_pool2d(x, self.output_size)
+
+
+class MaxPool3D(Layer):
+    """python/paddle/nn/layer/pooling.py MaxPool3D; x [B,C,D,H,W]."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.ceil_mode, self.return_mask = ceil_mode, return_mask
+        self.data_format = data_format
+
+    def forward(self, x):
+        return nn_ops.max_pool3d(x, self.kernel_size, self.stride,
+                                 self.padding, self.ceil_mode,
+                                 self.return_mask, self.data_format)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.exclusive = exclusive
+        self.ceil_mode, self.divisor_override = ceil_mode, divisor_override
+        self.data_format = data_format
+
+    def forward(self, x):
+        return nn_ops.avg_pool3d(x, self.kernel_size, self.stride,
+                                 self.padding, self.ceil_mode,
+                                 self.exclusive, self.divisor_override,
+                                 self.data_format)

@@ -1064,3 +1064,120 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None
         if p is not None:
             ins.append(as_tensor(p))
     return apply("fused_bias_dropout_residual_ln", fn, *ins)
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
+def _pool3d_fn(kernel_size, stride, padding, init, op, norm=False,
+               count_include_pad=True):
+    ks = _triple(kernel_size)
+    st = _triple(stride if stride is not None else kernel_size)
+    pd = _triple(padding)
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+
+    def fn(a):
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        out = jax.lax.reduce_window(a, init, op, window, strides,
+                                    padding=pad_cfg)
+        if norm:
+            cnt = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                        window, strides, padding=pad_cfg)
+            out = out / cnt
+        elif op is jax.lax.add:
+            out = out / float(np.prod(ks))
+        return out
+
+    return fn
+
+
+def _check_pool3d_args(ceil_mode, data_format, return_mask=False):
+    """Unsupported pool3d modes fail loudly instead of silently
+    computing the wrong thing."""
+    if ceil_mode:
+        raise NotImplementedError("pool3d: ceil_mode=True not supported")
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            f"pool3d: data_format={data_format!r}; NCDHW only")
+    if return_mask:
+        raise NotImplementedError("pool3d: return_mask not supported")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    """MaxPool3D (phi pool3d kernel analog); x [B,C,D,H,W]."""
+    _check_pool3d_args(ceil_mode, data_format, return_mask)
+    x = as_tensor(x)
+    return apply("max_pool3d",
+                 _pool3d_fn(kernel_size, stride, padding, -jnp.inf,
+                            jax.lax.max), x)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    _check_pool3d_args(ceil_mode, data_format)
+    if divisor_override is not None:
+        raise NotImplementedError("pool3d: divisor_override not supported")
+    x = as_tensor(x)
+    return apply("avg_pool3d",
+                 _pool3d_fn(kernel_size, stride, padding, 0.0, jax.lax.add,
+                            norm=exclusive), x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (phi unfold kernel analog): x [B,C,H,W] ->
+    [B, C*kh*kw, L] with L = Ho*Wo. Built on
+    conv_general_dilated_patches (one XLA gather, MXU-adjacent layout),
+    whose blocks are already channel-major (c, kh, kw) — the same
+    order paddle emits, so no reorder is needed (verified against a
+    manual im2col in tests)."""
+    x = as_tensor(x)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def fn(a):
+        p = jax.lax.conv_general_dilated_patches(
+            a, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])],
+            rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # p: [B, C*kh*kw, Ho, Wo] with channel-major blocks already
+        B, CK, Ho, Wo = p.shape
+        return p.reshape(B, CK, Ho * Wo)
+
+    return apply("unfold", fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (phi fold kernel analog): [B, C*kh*kw, L] -> [B,C,H,W],
+    overlapping patches summed. Implemented as the exact transpose of
+    unfold via the VJP of the patch extraction (adjoint-of-gather —
+    the XLA-native formulation of the reference's scatter kernel)."""
+    x = as_tensor(x)
+    oh, ow = _pair(output_sizes)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def fn(a):
+        B = a.shape[0]
+        C = a.shape[1] // (ks[0] * ks[1])
+
+        def extract(img):
+            p = jax.lax.conv_general_dilated_patches(
+                img, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])],
+                rhs_dilation=dl,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return p.reshape(B, p.shape[1], -1)
+
+        zeros = jnp.zeros((B, C, oh, ow), a.dtype)
+        _, vjp = jax.vjp(extract, zeros)
+        (out,) = vjp(a)
+        return out
+
+    return apply("fold", fn, x)

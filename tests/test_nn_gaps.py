@@ -1,0 +1,125 @@
+"""3-D pooling, Unfold/Fold (im2col/col2im), SpectralNorm, and
+ConcatDataset — reference python/paddle/nn/layer/{pooling,common,norm}.py
+and python/paddle/io.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import ConcatDataset, TensorDataset
+
+
+def test_max_avg_pool3d():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 4, 8, 8).astype(np.float32)
+    mx = nn.MaxPool3D(2)(paddle.to_tensor(x))
+    av = nn.AvgPool3D(2)(paddle.to_tensor(x))
+    assert mx.shape == [2, 3, 2, 4, 4] and av.shape == [2, 3, 2, 4, 4]
+    # numpy reference on one window
+    win = x[0, 0, :2, :2, :2]
+    np.testing.assert_allclose(mx.numpy()[0, 0, 0, 0, 0], win.max(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(av.numpy()[0, 0, 0, 0, 0], win.mean(),
+                               rtol=1e-5)
+
+
+def test_unfold_matches_manual_im2col():
+    img = np.arange(1 * 2 * 4 * 4, dtype=np.float32).reshape(1, 2, 4, 4)
+    u = nn.Unfold(2)(paddle.to_tensor(img)).numpy()
+    manual = np.zeros((1, 8, 9), np.float32)
+    i = 0
+    for ho in range(3):
+        for wo in range(3):
+            manual[0, :, i] = img[0][:, ho:ho + 2, wo:wo + 2].reshape(-1)
+            i += 1
+    np.testing.assert_allclose(u, manual)
+
+
+def test_fold_is_unfold_adjoint():
+    """fold(unfold(x)) multiplies each pixel by its patch multiplicity
+    (exactly 9 for interior pixels of a 3x3/s1/p1 unfold)."""
+    img = np.zeros((1, 1, 6, 6), np.float32)
+    img[0, 0, 3, 3] = 1.0
+    u = nn.Unfold(3, strides=1, paddings=1)(paddle.to_tensor(img))
+    f = nn.Fold((6, 6), 3, strides=1, paddings=1)(u).numpy()
+    assert f[0, 0, 3, 3] == 9.0 and f.sum() == 9.0
+
+
+def test_unfold_fold_gradients():
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(1, 2, 6, 6).astype(np.float32))
+    x.stop_gradient = False
+    u = nn.Unfold(3, paddings=1)(x)
+    nn.Fold((6, 6), 3, paddings=1)(u).sum().backward()
+    assert x.grad is not None
+    # d(sum fold(unfold(x)))/dx = patch multiplicity map (9 interior)
+    g = np.asarray(x.grad._array)
+    assert g[0, 0, 3, 3] == 9.0 and g[0, 0, 0, 0] == 4.0  # corner: 4
+
+
+def test_spectral_norm_unit_sigma():
+    paddle.seed(0)
+    sn = nn.SpectralNorm((8, 16), power_iters=30)
+    w = np.random.RandomState(2).randn(8, 16).astype(np.float32)
+    out = sn(paddle.to_tensor(w)).numpy()
+    # after normalization the top singular value is ~1
+    s = np.linalg.svd(out.reshape(8, -1), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, atol=1e-3)
+    # eval mode keeps u/v fixed (no iteration) but still normalizes
+    sn.eval()
+    out2 = sn(paddle.to_tensor(w)).numpy()
+    s2 = np.linalg.svd(out2.reshape(8, -1), compute_uv=False)
+    np.testing.assert_allclose(s2[0], 1.0, atol=1e-3)
+
+
+def test_spectral_norm_gradient_flows_to_weight():
+    paddle.seed(0)
+    sn = nn.SpectralNorm((4, 4), power_iters=5)
+    w = paddle.to_tensor(
+        np.random.RandomState(3).randn(4, 4).astype(np.float32))
+    w.stop_gradient = False
+    sn(w).sum().backward()
+    assert w.grad is not None
+
+
+def test_concat_dataset():
+    a = TensorDataset([paddle.to_tensor(np.arange(3, dtype=np.float32))])
+    b = TensorDataset([paddle.to_tensor(np.arange(10, 15,
+                                                  dtype=np.float32))])
+    cd = ConcatDataset([a, b])
+    assert len(cd) == 8
+    vals = [float(cd[i][0]._array) for i in range(8)]
+    assert vals == [0, 1, 2, 10, 11, 12, 13, 14]
+    assert float(cd[-1][0]._array) == 14
+
+
+def test_pool3d_rejects_unsupported_modes():
+    x = paddle.to_tensor(np.zeros((1, 1, 2, 4, 4), np.float32))
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        nn.MaxPool3D(2, ceil_mode=True)(x)
+    with pytest.raises(NotImplementedError, match="NCDHW"):
+        nn.AvgPool3D(2, data_format="NDHWC")(x)
+    with pytest.raises(NotImplementedError, match="return_mask"):
+        nn.MaxPool3D(2, return_mask=True)(x)
+
+
+def test_spectral_norm_eval_from_fresh_buffers_still_normalizes():
+    paddle.seed(1)
+    sn = nn.SpectralNorm((8, 16), power_iters=30)
+    sn.eval()  # never trained: power iteration must still run
+    w = np.random.RandomState(4).randn(8, 16).astype(np.float32)
+    out = sn(paddle.to_tensor(w)).numpy()
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, atol=1e-3)
+    # eval did not advance the stored state
+    u_before = np.asarray(sn.weight_u._array).copy()
+    sn(paddle.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(sn.weight_u._array), u_before)
+
+
+def test_concat_dataset_rejects_out_of_range_negative():
+    a = TensorDataset([paddle.to_tensor(np.arange(3, dtype=np.float32))])
+    cd = ConcatDataset([a])
+    with pytest.raises(ValueError, match="out of range"):
+        cd[-4]

@@ -25,6 +25,10 @@ from paddle_tpu.core.random import get_rng_state, set_rng_state
 from paddle_tpu import ops
 from paddle_tpu.ops.creation import (
     arange,
+    complex,
+    diagflat,
+    logspace,
+    vander,
     diag,
     empty,
     empty_like,
@@ -49,25 +53,31 @@ from paddle_tpu.ops.math import (
     logical_and, logical_not, logical_or, logical_xor, maximum, minimum, mod,
     multiply, multiplex, nan_to_num, neg, not_equal, pow, reciprocal, round,
     rsqrt, scale, sign, sin, sinh, sqrt, square, subtract, tan, tanh, trunc,
-    where, addmm,
+    where, addmm, erfinv, expm1, fmax, fmin,
+    frac, sinc, signbit, digamma, lgamma, i0, angle, real, imag, conj,
+    sgn, logit, polygamma, copysign, nextafter, heaviside, hypot,
+    logaddexp, fmod, remainder, true_divide, float_power, isclose,
+    allclose, equal_all, multiply_,
 )
 from paddle_tpu.ops.manipulation import (
     broadcast_to, chunk, clone, concat, crop, expand, expand_as, flatten,
     flip, gather, gather_nd, index_select, masked_select, moveaxis, numel,
     put_along_axis, repeat_interleave, reshape, roll, rot90, scatter, slice,
     split, squeeze, stack, strided_slice, take_along_axis, tile, transpose,
-    unbind, unsqueeze, unstack,
+    unbind, unsqueeze, unstack, as_complex, as_real, tensordot,
+    swapaxes, swapdims, vsplit, hsplit, dsplit, take, as_strided, diff,
+    scatter_nd, searchsorted, bucketize,
 )
 from paddle_tpu.ops.reduction import (
     all, amax, amin, any, argmax, argmin, argsort, bincount, count_nonzero,
     cumprod, cumsum, kthvalue, logsumexp, max, mean, median, min, mode,
     nanmean, nansum, nonzero, prod, quantile, sort, std, sum, topk, unique,
-    var,
+    var, nanmedian, trapezoid,
 )
 from paddle_tpu.ops.linalg import (
     bmm, cross, det, diagonal, dist, dot, eigh, histogram, inner, inverse,
     kron, matmul, mm, mv, norm, outer, pinv, qr, slogdet, solve, svd, t,
-    trace,
+    trace, einsum, baddbmm, renorm, corrcoef, cov,
 )
 from paddle_tpu.ops.random_ops import (
     bernoulli, multinomial, normal, poisson, rand, randint, randint_like,

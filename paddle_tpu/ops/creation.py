@@ -1,6 +1,7 @@
 """Tensor creation ops — analog of python/paddle/tensor/creation.py."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -25,6 +26,10 @@ __all__ = [
     "triu",
     "meshgrid",
     "one_hot",
+    "logspace",
+    "vander",
+    "diagflat",
+    "complex",
 ]
 
 
@@ -123,3 +128,56 @@ def one_hot(x, num_classes, dtype=None) -> Tensor:
     arr = x._array if isinstance(x, Tensor) else jnp.asarray(x)
     out = jax.nn.one_hot(arr, num_classes, dtype=dtypes.to_jax(dtype or dtypes.get_default_dtype()))
     return Tensor._wrap(out)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    from paddle_tpu.core import dtype as dtypes
+
+    jd = dtypes.to_jax(dtype) if dtype is not None else jnp.float32
+    return Tensor._wrap(jnp.logspace(float(start), float(stop), int(num),
+                                     base=float(base), dtype=jd))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    from .dispatch import apply, as_tensor
+
+    x = as_tensor(x)
+    return apply("vander",
+                 lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def diagflat(x, offset=0, name=None):
+    from .dispatch import apply, as_tensor
+
+    x = as_tensor(x)
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def complex(real, imag, name=None):
+    """Build a complex tensor from real/imag parts (paddle.complex).
+    On backends without complex buffers (core.device.supports_complex)
+    the result lives CPU-side, like complex creation in Tensor()."""
+    from .dispatch import apply, as_tensor
+
+    r = as_tensor(real)
+    i = as_tensor(imag, r)
+
+    def fn(a, b):
+        a, b = jnp.broadcast_arrays(a.astype(jnp.float32),
+                                    b.astype(jnp.float32))
+        return jax.lax.complex(a, b)
+
+    from paddle_tpu.core.device import supports_complex
+
+    if not supports_complex() and \
+            not isinstance(r._array, jax.core.Tracer):
+        from .dispatch import apply_with_cpu_fallback
+
+        # two-input op: hop both (broadcast) inputs via one packed call
+        ra, ia = jnp.broadcast_arrays(r._array.astype(jnp.float32),
+                                      i._array.astype(jnp.float32))
+        packed = Tensor._wrap(jnp.stack([ra, ia]))
+        return apply_with_cpu_fallback(
+            apply, "complex", lambda p: jax.lax.complex(p[0], p[1]),
+            packed, supports_complex, complex_stays_on_cpu=True)
+    return apply("complex", fn, r, i)

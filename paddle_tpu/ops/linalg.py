@@ -18,6 +18,7 @@ __all__ = [
     "cross", "cholesky", "inverse", "pinv", "solve", "triangular_solve",
     "svd", "qr", "eigh", "det", "slogdet", "matrix_power", "trace",
     "diagonal", "kron", "mv", "histogram",
+    "einsum", "baddbmm", "renorm", "corrcoef", "cov",
 ]
 
 
@@ -200,3 +201,53 @@ def histogram(x, bins=100, min=0, max=0):
         return h
 
     return apply_nograd("histogram", fn, x)
+
+
+def einsum(equation, *operands, name=None):
+    """paddle.einsum — one MXU-friendly contraction (XLA lowers einsum
+    straight to dot_general chains)."""
+    ts = [as_tensor(o) for o in operands]
+    return apply("einsum", lambda *arrs: jnp.einsum(equation, *arrs), *ts)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) batched (paddle baddbmm)."""
+    i, x, y = as_tensor(input), as_tensor(x), as_tensor(y)
+    return apply("baddbmm",
+                 lambda a, b, c: beta * a + alpha *
+                 jnp.matmul(b, c), i, x, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice along `axis` to p-norm <= max_norm."""
+    x = as_tensor(x)
+
+    def fn(a):
+        red = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=red, keepdims=True) \
+            ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                           1.0)
+        return a * factor
+
+    return apply("renorm", fn, x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = as_tensor(x)
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None,
+        name=None):
+    x = as_tensor(x)
+    fw = None if fweights is None else \
+        (fweights._array if isinstance(fweights, Tensor)
+         else jnp.asarray(fweights))
+    aw = None if aweights is None else \
+        (aweights._array if isinstance(aweights, Tensor)
+         else jnp.asarray(aweights))
+    return apply("cov",
+                 lambda a: jnp.cov(a, rowvar=rowvar,
+                                   ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x)

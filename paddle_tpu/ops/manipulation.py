@@ -21,6 +21,8 @@ __all__ = [
     "put_along_axis", "slice", "strided_slice", "getitem", "clone",
     "repeat_interleave", "unstack", "as_complex", "as_real", "pad",
     "crop", "rot90", "numel", "tensordot", "squeeze_", "unsqueeze_",
+    "swapaxes", "swapdims", "vsplit", "hsplit", "dsplit", "take",
+    "as_strided", "diff", "scatter_nd", "searchsorted", "bucketize",
 ]
 
 
@@ -397,3 +399,127 @@ def numel(x):
 def tensordot(x, y, axes=2):
     x, y = as_tensor(x), as_tensor(y)
     return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes), x, y)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = as_tensor(x)
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), x)
+
+
+swapdims = swapaxes
+
+
+def _axis_split(opname, jfn, min_ndim):
+    """numpy/paddle split-family semantics: an int divides into equal
+    sections; a list gives the INDICES to split at (not section sizes —
+    that is split()'s convention, not this family's)."""
+    def op(x, num_or_indices, name=None):
+        x = as_tensor(x)
+        if x.ndim < min_ndim:
+            raise ValueError(
+                f"{opname} requires at least {min_ndim}-D input, "
+                f"got {x.ndim}-D")
+        spec = num_or_indices if isinstance(num_or_indices, int) \
+            else [int(i) for i in num_or_indices]
+        return apply(opname, lambda a: tuple(jfn(a, spec)), x)
+
+    op.__name__ = opname
+    return op
+
+
+vsplit = _axis_split("vsplit", jnp.vsplit, 2)
+hsplit = _axis_split("hsplit", jnp.hsplit, 1)
+dsplit = _axis_split("dsplit", jnp.dsplit, 3)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flattened-index gather (paddle take): index anywhere in
+    [-numel, numel). mode: 'raise' validates eagerly (clips under a
+    trace — XLA cannot raise), 'clip', 'wrap'."""
+    x = as_tensor(x)
+    idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
+    n = int(np.prod(x.shape)) if x.shape else 1
+    if mode == "raise" and not isinstance(idx, jax.core.Tracer):
+        bad = (np.asarray(idx) < -n) | (np.asarray(idx) >= n)
+        if bad.any():
+            raise IndexError(f"take: index out of range for numel {n}")
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:  # raise (validated above) and clip both clamp for the gather
+        idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+    return apply("take", lambda a: a.reshape(-1)[idx], x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """View-by-strides (paddle as_strided). XLA has no aliasing views;
+    this materializes the equivalent gather: element [i0,i1,...] =
+    flat[offset + sum(ik*stride[k])]."""
+    x = as_tensor(x)
+    shape = tuple(int(s) for s in shape)
+    stride = tuple(int(s) for s in stride)
+
+    def fn(a):
+        flat = a.reshape(-1)
+        if not shape:
+            return flat[offset]
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape],
+                             indexing="ij")
+        flat_idx = offset
+        for g, st in zip(grids, stride):
+            flat_idx = flat_idx + g * st
+        return flat[flat_idx]
+
+    return apply("as_strided", fn, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    pre = None if prepend is None else \
+        (prepend._array if isinstance(prepend, Tensor)
+         else jnp.asarray(prepend))
+    app = None if append is None else \
+        (append._array if isinstance(append, Tensor)
+         else jnp.asarray(append))
+    return apply("diff",
+                 lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                    append=app), x)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """zeros(shape) scatter-ADDED with updates at index (paddle
+    scatter_nd; phi scatter_nd_add into zeros)."""
+    updates = as_tensor(updates)
+    idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
+    shape = tuple(int(s) for s in shape)
+
+    def fn(u):
+        z = jnp.zeros(shape, u.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return apply("scatter_nd", fn, updates)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    seq = as_tensor(sorted_sequence)
+    vals = values._array if isinstance(values, Tensor) \
+        else jnp.asarray(values)
+    side = "right" if right else "left"
+
+    def fn(s):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, vals, side=side)
+        else:  # batched rows (paddle nd semantics: last dim sorted)
+            out = jax.vmap(lambda row, v:
+                           jnp.searchsorted(row, v, side=side))(
+                s.reshape(-1, s.shape[-1]),
+                vals.reshape(-1, vals.shape[-1]))
+            out = out.reshape(vals.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_nograd("searchsorted", fn, seq)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32,
+                        right=right)

@@ -27,6 +27,10 @@ __all__ = [
     "isnan", "isinf", "isfinite", "bitwise_and", "bitwise_or", "bitwise_xor",
     "bitwise_not", "where", "cast", "increment", "stanh", "multiplex",
     "nan_to_num",
+    "frac", "sinc", "signbit", "digamma", "lgamma", "i0", "angle", "real",
+    "imag", "conj", "sgn", "logit", "polygamma", "copysign", "nextafter",
+    "heaviside", "hypot", "logaddexp", "fmod", "remainder", "true_divide",
+    "float_power", "isclose", "allclose", "equal_all", "multiply_",
 ]
 
 
@@ -57,12 +61,14 @@ def _binary_nograd(name, fn):
     return op
 
 
-def _unary(name, fn):
-    def op(x):
-        x = as_tensor(x)
-        return apply(name, fn, x)
+def _unary(opname, fn, nograd=False):
+    ap = apply_nograd if nograd else apply
 
-    op.__name__ = name
+    def op(x, name=None):
+        x = as_tensor(x)
+        return ap(opname, fn, x)
+
+    op.__name__ = opname
     return op
 
 
@@ -228,3 +234,110 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
     return apply(
         "nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x
     )
+
+
+# -- special functions / complex / residual elementwise parity ----------
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+sinc = _unary("sinc", jnp.sinc)
+signbit = _unary("signbit", jnp.signbit, nograd=True)
+digamma = _unary("digamma", lambda a: jax.scipy.special.digamma(a))
+lgamma = _unary("lgamma", lambda a: jax.scipy.special.gammaln(a))
+i0 = _unary("i0", lambda a: jax.scipy.special.i0(a))
+angle = _unary("angle", jnp.angle)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+conj = _unary("conj", jnp.conj)
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| (0 -> 0) for complex (paddle sgn)."""
+    x = as_tensor(x)
+
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            m = jnp.abs(a)
+            return jnp.where(m == 0, 0.0 + 0.0j, a / jnp.where(m == 0, 1, m))
+        return jnp.sign(a)
+
+    return apply("sgn", fn, x)
+
+
+def logit(x, eps=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        p = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(p / (1.0 - p))
+
+    return apply("logit", fn, x)
+
+
+def polygamma(x, n, name=None):
+    x = as_tensor(x)
+    return apply("polygamma",
+                 lambda a: jax.scipy.special.polygamma(int(n), a), x)
+
+
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+heaviside = _binary("heaviside", jnp.heaviside)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+fmod = _binary("fmod", jnp.fmod)
+remainder = _binary("remainder", jnp.remainder)
+
+
+def true_divide(x, y, name=None):
+    """Always-float division (paddle true_divide)."""
+    if not isinstance(x, Tensor):
+        x = as_tensor(x, y if isinstance(y, Tensor) else None)
+    y = as_tensor(y, x)
+    return apply("true_divide", jnp.true_divide, x, y)
+
+
+def float_power(x, y, name=None):
+    if not isinstance(x, Tensor):
+        x = as_tensor(x, y if isinstance(y, Tensor) else None)
+    y = as_tensor(y, x)
+    return apply("float_power", jnp.float_power, x, y)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply_nograd(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan), x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    return apply_nograd(
+        "allclose",
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        if a.shape != b.shape:  # static: works traced and concrete
+            return jnp.asarray(False)
+        return (a == b).all()
+
+    return apply_nograd("equal_all", fn, x, y)
+
+
+def multiply_(x, y, name=None):
+    """In-place multiply (paddle inplace-op parity): x <- x * y.
+    Like paddle, in-place mutation of a tensor that requires grad is
+    refused (the tape cannot alias the overwritten value)."""
+    x = as_tensor(x)
+    if not x.stop_gradient:
+        raise RuntimeError(
+            "multiply_: in-place op on a tensor that requires grad; use "
+            "x = x * y (out-of-place) inside differentiated code")
+    new = multiply(x, y)
+    x._mutate(new._array)
+    return x

@@ -3,6 +3,7 @@
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,7 +16,7 @@ __all__ = [
     "argmax", "argmin", "argsort", "sort", "topk", "all", "any",
     "cumsum", "cumprod", "logsumexp", "amax", "amin", "count_nonzero",
     "nansum", "nanmean", "kthvalue", "mode", "unique", "nonzero",
-    "quantile", "bincount",
+    "quantile", "bincount", "nanmedian", "trapezoid",
 ]
 
 
@@ -242,3 +243,25 @@ def bincount(x, weights=None, minlength=0):
     return apply_nograd(
         "bincount", lambda a: jnp.bincount(a, weights=w, minlength=minlength), x
     )
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return apply("nanmedian",
+                 lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim),
+                 x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (paddle.trapezoid)."""
+    y = as_tensor(y)
+    xs = None if x is None else \
+        (x._array if isinstance(x, Tensor) else jnp.asarray(x))
+    d = 1.0 if dx is None else float(dx)
+
+    def fn(a):
+        if xs is not None:
+            return jax.scipy.integrate.trapezoid(a, x=xs, axis=axis)
+        return jax.scipy.integrate.trapezoid(a, dx=d, axis=axis)
+
+    return apply("trapezoid", fn, y)

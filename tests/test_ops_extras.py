@@ -1,0 +1,217 @@
+"""Tensor-op parity batch (closing the paddle.* surface gap): special
+functions, complex accessors, index/search ops, splits, linalg extras —
+each checked against its numpy/scipy reference.
+"""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+
+RS = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_special_functions_match_scipy():
+    x = RS.rand(32).astype(np.float32) * 0.8 + 0.1
+    np.testing.assert_allclose(paddle.digamma(_t(x)).numpy(),
+                               sps.digamma(x), rtol=1e-4)
+    np.testing.assert_allclose(paddle.lgamma(_t(x)).numpy(),
+                               sps.gammaln(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.i0(_t(x)).numpy(), sps.i0(x),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.erfinv(_t(x)).numpy(),
+                               sps.erfinv(x), rtol=1e-3)
+    np.testing.assert_allclose(
+        paddle.polygamma(_t(x), 1).numpy(), sps.polygamma(1, x), rtol=1e-3)
+    np.testing.assert_allclose(paddle.logit(_t(x)).numpy(),
+                               sps.logit(x), rtol=1e-4)
+
+
+def test_elementwise_binary_parity():
+    a = RS.randn(16).astype(np.float32)
+    b = RS.randn(16).astype(np.float32) + 0.1
+    for name in ["copysign", "nextafter", "heaviside", "hypot",
+                 "logaddexp", "fmod", "remainder"]:
+        ours = getattr(paddle, name)(_t(a), _t(b)).numpy()
+        ref = getattr(np, name)(a, b)
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+    np.testing.assert_allclose(paddle.frac(_t(a)).numpy(),
+                               a - np.trunc(a), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sinc(_t(a)).numpy(), np.sinc(a),
+                               rtol=1e-4, atol=1e-5)
+    assert (paddle.signbit(_t(a)).numpy() == np.signbit(a)).all()
+
+
+def test_complex_accessors():
+    r = RS.randn(8).astype(np.float32)
+    i = RS.randn(8).astype(np.float32)
+    c = paddle.complex(_t(r), _t(i))
+    np.testing.assert_allclose(paddle.real(c).numpy(), r, rtol=1e-6)
+    np.testing.assert_allclose(paddle.imag(c).numpy(), i, rtol=1e-6)
+    np.testing.assert_allclose(paddle.conj(c).numpy(), r - 1j * i,
+                               rtol=1e-6)
+    np.testing.assert_allclose(paddle.angle(c).numpy(),
+                               np.angle(r + 1j * i), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(paddle.sgn(c).numpy(),
+                               (r + 1j * i) / np.abs(r + 1j * i),
+                               rtol=1e-4)
+
+
+def test_take_modes():
+    x = _t(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(
+        paddle.take(x, np.array([0, 5, -1])).numpy(), [0, 5, 11])
+    np.testing.assert_allclose(
+        paddle.take(x, np.array([13]), mode="wrap").numpy(), [1])
+    np.testing.assert_allclose(
+        paddle.take(x, np.array([13]), mode="clip").numpy(), [11])
+    with pytest.raises(IndexError):
+        paddle.take(x, np.array([100]))
+
+
+def test_searchsorted_and_bucketize():
+    seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    vals = np.array([0.0, 3.0, 6.0, 9.0], np.float32)
+    np.testing.assert_array_equal(
+        paddle.searchsorted(_t(seq), _t(vals)).numpy(),
+        np.searchsorted(seq, vals))
+    np.testing.assert_array_equal(
+        paddle.searchsorted(_t(seq), _t(vals), right=True).numpy(),
+        np.searchsorted(seq, vals, side="right"))
+    np.testing.assert_array_equal(
+        paddle.bucketize(_t(vals), _t(seq)).numpy(),
+        np.searchsorted(seq, vals))
+
+
+def test_as_strided_and_diff():
+    x = np.arange(12, dtype=np.float32)
+    out = paddle.as_strided(_t(x), [3, 4], [4, 1]).numpy()
+    np.testing.assert_allclose(out, x.reshape(3, 4))
+    # overlapping windows: classic stride trick
+    win = paddle.as_strided(_t(x), [5, 3], [2, 1]).numpy()
+    ref = np.lib.stride_tricks.as_strided(x, (5, 3), (8, 4))
+    np.testing.assert_allclose(win, ref)
+    d = RS.randn(4, 6).astype(np.float32)
+    np.testing.assert_allclose(paddle.diff(_t(d)).numpy(),
+                               np.diff(d), rtol=1e-6)
+    np.testing.assert_allclose(paddle.diff(_t(d), n=2, axis=0).numpy(),
+                               np.diff(d, n=2, axis=0), rtol=1e-5)
+
+
+def test_scatter_nd():
+    idx = np.array([[1], [3], [1]], np.int64)
+    upd = np.array([9.0, 10.0, 11.0], np.float32)
+    out = paddle.scatter_nd(_t(idx), _t(upd), [6]).numpy()
+    np.testing.assert_allclose(out, [0, 20, 0, 10, 0, 0])  # adds collide
+
+
+def test_splits_and_swaps():
+    x = RS.randn(4, 6, 8).astype(np.float32)
+    vs = paddle.vsplit(_t(x), 2)
+    assert len(vs) == 2 and vs[0].shape == [2, 6, 8]
+    hs = paddle.hsplit(_t(x), 3)
+    assert hs[0].shape == [4, 2, 8]
+    ds = paddle.dsplit(_t(x), 4)
+    assert ds[0].shape == [4, 6, 2]
+    np.testing.assert_allclose(paddle.swapaxes(_t(x), 0, 2).numpy(),
+                               np.swapaxes(x, 0, 2))
+
+
+def test_linalg_extras():
+    a = RS.randn(3, 4).astype(np.float32)
+    b = RS.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", _t(a), _t(b)).numpy(), a @ b,
+        rtol=1e-4, atol=1e-5)
+    base = RS.randn(2, 3, 5).astype(np.float32)
+    x3 = RS.randn(2, 3, 4).astype(np.float32)
+    y3 = RS.randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.baddbmm(_t(base), _t(x3), _t(y3), beta=0.5,
+                       alpha=2.0).numpy(),
+        0.5 * base + 2.0 * (x3 @ y3), rtol=1e-4, atol=1e-5)
+    m = RS.randn(4, 16).astype(np.float32)
+    np.testing.assert_allclose(paddle.corrcoef(_t(m)).numpy(),
+                               np.corrcoef(m), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.cov(_t(m)).numpy(), np.cov(m),
+                               rtol=1e-3, atol=1e-4)
+    rn = paddle.renorm(_t(m), 2.0, 0, 1.0).numpy()
+    norms = np.linalg.norm(rn, axis=1)
+    assert (norms <= 1.0 + 1e-4).all()
+
+
+def test_reduction_extras_and_misc():
+    x = RS.randn(4, 5).astype(np.float32)
+    x[0, 0] = np.nan
+    np.testing.assert_allclose(paddle.nanmedian(_t(x)).numpy(),
+                               np.nanmedian(x), rtol=1e-6)
+    y = RS.randn(8).astype(np.float32)
+    np.testing.assert_allclose(paddle.trapezoid(_t(y), dx=0.5).numpy(),
+                               np.trapz(y, dx=0.5), rtol=1e-5)
+    assert bool(paddle.equal_all(_t(y), _t(y)).numpy())
+    assert bool(paddle.allclose(_t(y), _t(y + 1e-9)).numpy())
+    assert not bool(paddle.equal_all(_t(y), _t(y + 1)).numpy())
+    np.testing.assert_allclose(paddle.logspace(0, 3, 4).numpy(),
+                               [1, 10, 100, 1000], rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.vander(_t(np.array([1.0, 2.0, 3.0], np.float32))).numpy(),
+        np.vander([1, 2, 3]), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.diagflat(_t(np.array([1.0, 2.0], np.float32))).numpy(),
+        np.diagflat([1, 2]), rtol=1e-6)
+    t = _t(np.array([2.0, 3.0], np.float32))
+    r = paddle.multiply_(t, _t(np.array([4.0, 5.0], np.float32)))
+    assert r is t
+    np.testing.assert_allclose(t.numpy(), [8, 15])
+
+
+def test_new_ops_differentiable():
+    x = _t(RS.rand(8).astype(np.float32) * 0.8 + 0.1)
+    x.stop_gradient = False
+    (paddle.digamma(x).sum() + paddle.logit(x).sum() +
+     paddle.frac(x).sum()).backward()
+    assert x.grad is not None
+    a = _t(RS.randn(3, 4).astype(np.float32))
+    a.stop_gradient = False
+    paddle.einsum("ij->j", a).sum().backward()
+    np.testing.assert_allclose(np.asarray(a.grad._array), 1.0)
+
+
+def test_split_family_index_semantics():
+    """vsplit/hsplit/dsplit take split INDICES (numpy/paddle), not
+    section sizes."""
+    x = np.arange(24, dtype=np.float32).reshape(6, 4)
+    parts = paddle.vsplit(_t(x), [2, 4])
+    assert [p.shape[0] for p in parts] == [2, 2, 2]
+    np.testing.assert_allclose(parts[1].numpy(), x[2:4])
+    # hsplit works on 1-D (splits axis 0), dsplit requires 3-D
+    one_d = paddle.hsplit(_t(np.arange(6, dtype=np.float32)), 2)
+    assert [p.shape[0] for p in one_d] == [3, 3]
+    with pytest.raises(ValueError, match="3-D"):
+        paddle.dsplit(_t(x), 2)
+
+
+def test_multiply_inplace_guards_grad():
+    t = _t(np.array([2.0], np.float32))
+    t.stop_gradient = False
+    with pytest.raises(RuntimeError, match="in-place"):
+        paddle.multiply_(t, _t(np.array([3.0], np.float32)))
+
+
+def test_complex_broadcasts():
+    r = np.ones((3, 1), np.float32)
+    i = np.zeros((3, 4), np.float32)
+    c = paddle.complex(_t(r), _t(i))
+    assert c.shape == [3, 4]
+
+
+def test_ops_accept_name_kwarg():
+    x = _t(np.array([0.5], np.float32))
+    paddle.lgamma(x, name="lg")
+    paddle.frac(x, name="f")
+    paddle.abs(x, name="a")

@@ -135,13 +135,11 @@ class Engine:
             sh = self.strategy.sharding
             stage = int(sh.stage) if sh.enable else 0
             gm = self.strategy.gradient_merge
-            if gm.enable and int(gm.k_steps) > 1:
-                raise NotImplementedError(
-                    "gradient_merge under the auto-parallel Engine: use "
-                    "jit.TrainStep(accumulate_steps=k) directly")
+            k = int(gm.k_steps) if gm.enable else 1
             self._step = DistributedTrainStep(
                 self.model, self.optimizer, self.loss, hcg=hcg,
-                sharding_stage=stage, offload=bool(sh.offload))
+                sharding_stage=stage, offload=bool(sh.offload),
+                accumulate_steps=k, accumulate_avg=bool(gm.avg))
         return self._step
 
     # -- train/eval/predict loops -----------------------------------------

@@ -86,7 +86,8 @@ class DistributedTrainStep:
                  hcg: Optional[HybridCommunicateGroup] = None,
                  sharding_stage: Optional[int] = None,
                  batch_axes=("dp", "sharding"),
-                 donate: bool = True, offload: Optional[bool] = None):
+                 donate: bool = True, offload: Optional[bool] = None,
+                 accumulate_steps: int = 1, accumulate_avg: bool = True):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -111,6 +112,18 @@ class DistributedTrainStep:
         self._jitted = None
         self._donate = donate
         self._placed = False
+        # gradient merge (GradientMergeOptimizer k_steps analog, mesh
+        # edition): K micro-batch calls accumulate into fp32 buffers
+        # sharded like the optimizer state (ZeRO stages shard them),
+        # the K-th call applies the MEAN
+        self.accumulate_steps = int(accumulate_steps)
+        self.accumulate_avg = bool(accumulate_avg)
+        self._accum_count = 0
+        self._grad_bufs = None
+        if self.accumulate_steps > 1 and self.offload:
+            raise NotImplementedError(
+                "accumulate_steps with optimizer-state offload is not "
+                "supported")
 
     # -- sharding plan -----------------------------------------------------
     def _param_shardings(self):
@@ -244,8 +257,81 @@ class DistributedTrainStep:
         args = self._prep_args(inputs, label, advance_rng=False)
         return self._jitted.lower(*args)
 
+    # -- gradient merge ----------------------------------------------------
+    def _build_accum_fns(self):
+        """Mesh edition of gradient merge: the SAME closure pair as
+        TrainStep (jit.api.make_accum_fns — nan-check and avg/sum
+        semantics can't drift), jitted with mesh shardings. Buffer
+        shardings follow accum_pspec, so ZeRO stages reduce-scatter the
+        merge buffers instead of replicating them; the dp grad psum is
+        inserted by XLA from the batch sharding."""
+        from paddle_tpu.jit.api import make_accum_fns
+
+        acc_fn, upd_fn = make_accum_fns(
+            self.model, self.optimizer, self.loss_fn, self._params,
+            self._acc_idx, self.accumulate_steps,
+            avg=self.accumulate_avg)
+        mesh = self.hcg.mesh
+        repl = NamedSharding(mesh, P())
+        buf_sh = self._acc_dev_shardings()
+        _, param_sh = self._param_shardings()
+        accum_names = list(self.optimizer._accumulators.keys())
+        acc_sh = {k: buf_sh for k in accum_names}
+
+        donate = (0,) if self._donate else ()
+        acc_jit = jax.jit(acc_fn, donate_argnums=donate,
+                          out_shardings=(repl, buf_sh))
+        upd_jit = jax.jit(
+            upd_fn,
+            donate_argnums=(0, 1, 2) if self._donate else (),
+            out_shardings=(param_sh, acc_sh, buf_sh))
+        return acc_jit, upd_jit
+
+    def _call_accumulate(self, inputs, label):
+        from paddle_tpu.core import random as random_mod
+        from paddle_tpu.framework.flags import debug_epoch
+        from paddle_tpu.jit.api import gather_accums, scatter_accums
+
+        if not self._placed:
+            self.place_params()
+        if getattr(self, "_acc_jitted", None) is None or \
+                getattr(self, "_acc_epoch", None) != debug_epoch():
+            self._acc_jitted, self._upd_jitted = self._build_accum_fns()
+            self._acc_epoch = debug_epoch()
+        opt = self.optimizer
+        mesh = self.hcg.mesh
+        bs = NamedSharding(mesh, P(self.batch_axes))
+        in_arrays = tuple(jax.device_put(_unwrap(i), bs) for i in inputs)
+        label_arr = None if label is None else \
+            jax.device_put(_unwrap(label), bs)
+        if self._grad_bufs is None:
+            sh = self._acc_dev_shardings()
+            self._grad_bufs = [
+                jax.device_put(jnp.zeros(p._array.shape, jnp.float32),
+                               sh[i])
+                for i, p in enumerate(self._params)]
+        loss, self._grad_bufs = self._acc_jitted(
+            self._grad_bufs, [p._array for p in self._params],
+            in_arrays, label_arr, random_mod.next_key())
+        self._accum_count += 1
+        if self._accum_count >= self.accumulate_steps:
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            stepc = jnp.asarray(opt._step_count, jnp.int32)
+            new_params, new_accums, self._grad_bufs = self._upd_jitted(
+                [p._array for p in self._params],
+                gather_accums(opt, self._acc_idx), self._grad_bufs,
+                lr, stepc)
+            for p, a in zip(self._params, new_params):
+                p._in_place_update(a)
+            scatter_accums(opt, self._acc_idx, new_accums)
+            opt._step_count += 1
+            self._accum_count = 0
+        return Tensor._wrap(loss)
+
     def __call__(self, *inputs, label=None):
         inputs, label = self._split_label(inputs, label)
+        if self.accumulate_steps > 1:
+            return self._call_accumulate(inputs, label)
         args = self._prep_args(inputs, label)
         from paddle_tpu.jit.api import scatter_accums
 

@@ -460,6 +460,44 @@ def build_step_fn(model, opt, loss_fn, params, acc_idx,
     return step_fn
 
 
+def make_accum_fns(model, optimizer, loss_fn, params, acc_idx, K,
+                   avg=True):
+    """Gradient-merge closure pair shared by TrainStep and
+    DistributedTrainStep: accumulate (forward+backward into f32
+    buffers, no update; FLAGS_check_nan_inf staged per micro-step) and
+    apply (optimizer update from the MEAN — or SUM when avg=False,
+    GradientMergeOptimizer parity — buffers zeroed). Built from the
+    same make_forward_loss/make_update_fn pieces as the normal step so
+    clip/nan-check behavior can't drift; callers add their own jit
+    options/shardings."""
+    from paddle_tpu.framework import nan_inf
+
+    forward_loss = make_forward_loss(model, loss_fn, params)
+    update = make_update_fn(optimizer, acc_idx, params)
+
+    def acc_fn(bufs, param_arrays, inputs, label, rng):
+        loss, grads = jax.value_and_grad(forward_loss)(
+            param_arrays, inputs, label, rng)
+        if nan_inf.check_enabled():
+            named = [("loss", loss)] + [
+                (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
+                for i, (p, g) in enumerate(zip(params, grads))]
+            nan_inf.stage_check(named, "gradient-merge micro-step")
+        return loss, [b + g.astype(jnp.float32)
+                      for b, g in zip(bufs, grads)]
+
+    def upd_fn(param_arrays, accums, bufs, lr, step):
+        div = K if avg else 1
+        grads = [(b / div).astype(p.dtype)
+                 for b, p in zip(bufs, param_arrays)]
+        new_params, new_accums = update(param_arrays, grads, accums,
+                                        lr, step)
+        zeroed = [jnp.zeros_like(b) for b in bufs]
+        return new_params, new_accums, zeroed
+
+    return acc_fn, upd_fn
+
+
 def gather_accums(opt, acc_idx):
     """Select the accumulator slots for the trained-param subset (aligned
     with acc_idx into the optimizer's parameter list)."""
@@ -620,39 +658,11 @@ class TrainStep:
         return losses
 
     def _build_accum_fns(self):
-        """Two programs for gradient merge: accumulate (forward+backward
-        into f32 buffers, no update) and apply (optimizer update from the
-        MEAN of the merged grads, buffers zeroed). All buffers donated.
-        Built from the same make_forward_loss/make_update_fn pieces as
-        the normal step so clip/nan-check behavior can't drift."""
-        from paddle_tpu.framework import nan_inf
-
-        forward_loss = make_forward_loss(self.model, self.loss_fn,
-                                         self._params)
-        update = make_update_fn(self.optimizer, self._acc_idx,
-                                self._params)
-        params = self._params
-        K = self.accumulate_steps
-
-        def acc_fn(bufs, param_arrays, inputs, label, rng):
-            loss, grads = jax.value_and_grad(forward_loss)(
-                param_arrays, inputs, label, rng)
-            if nan_inf.check_enabled():
-                named = [("loss", loss)] + [
-                    (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
-                    for i, (p, g) in enumerate(zip(params, grads))]
-                nan_inf.stage_check(named, "gradient-merge micro-step")
-            return loss, [b + g.astype(jnp.float32)
-                          for b, g in zip(bufs, grads)]
-
-        def upd_fn(param_arrays, accums, bufs, lr, step):
-            grads = [(b / K).astype(p.dtype)
-                     for b, p in zip(bufs, param_arrays)]
-            new_params, new_accums = update(param_arrays, grads, accums,
-                                            lr, step)
-            zeroed = [jnp.zeros_like(b) for b in bufs]
-            return new_params, new_accums, zeroed
-
+        """Two programs for gradient merge (shared closures from
+        make_accum_fns so the mesh edition can't drift)."""
+        acc_fn, upd_fn = make_accum_fns(
+            self.model, self.optimizer, self.loss_fn, self._params,
+            self._acc_idx, self.accumulate_steps)
         donate = (0,) if self._donate else ()
         return (jax.jit(acc_fn, donate_argnums=donate),
                 jax.jit(upd_fn, donate_argnums=(0, 1, 2)

@@ -436,6 +436,9 @@ def take(x, index, mode="raise", name=None):
     """Flattened-index gather (paddle take): index anywhere in
     [-numel, numel). mode: 'raise' validates eagerly (clips under a
     trace — XLA cannot raise), 'clip', 'wrap'."""
+    if mode not in ("raise", "clip", "wrap"):
+        raise ValueError(f"take: invalid mode {mode!r}; "
+                         "expected 'raise', 'clip' or 'wrap'")
     x = as_tensor(x)
     idx = index._array if isinstance(index, Tensor) else jnp.asarray(index)
     n = int(np.prod(x.shape)) if x.shape else 1
@@ -515,7 +518,10 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False,
                 s.reshape(-1, s.shape[-1]),
                 vals.reshape(-1, vals.shape[-1]))
             out = out.reshape(vals.shape)
-        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        # int64 only when the runtime allows it (x64-disabled jax
+        # truncates int64 to int32 with a warning); out_int32 forces 32
+        wide = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        return out.astype(jnp.int32 if out_int32 else wide)
 
     return apply_nograd("searchsorted", fn, seq)
 

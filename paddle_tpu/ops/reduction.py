@@ -254,6 +254,9 @@ def nanmedian(x, axis=None, keepdim=False, name=None):
 
 def trapezoid(y, x=None, dx=None, axis=-1, name=None):
     """Trapezoidal integration (paddle.trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid: pass x (coordinates) OR dx "
+                         "(uniform spacing), not both")
     y = as_tensor(y)
     xs = None if x is None else \
         (x._array if isinstance(x, Tensor) else jnp.asarray(x))

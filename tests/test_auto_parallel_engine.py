@@ -181,3 +181,15 @@ def test_step_structured_pytree_inputs_preserved():
     out = _unwrap([a, a])
     assert isinstance(out, list) and len(out) == 2  # untouched pytree
     assert isinstance(_unwrap([np.int64(1), np.int64(0)]), np.ndarray)
+
+
+def test_engine_gradient_merge():
+    strategy = auto.Strategy()
+    strategy.gradient_merge.enable = True
+    strategy.gradient_merge.k_steps = 2
+    eng, _ = _engine(strategy=strategy)
+    ds = _RandDS()
+    hist = eng.fit(ds, epochs=2, batch_size=16)  # 8 micro-steps/epoch
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert eng._step.accumulate_steps == 2
+    assert eng.optimizer._step_count == 8  # 16 micro / 2

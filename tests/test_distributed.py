@@ -337,3 +337,73 @@ def test_fleet_init_builds_mesh():
     from paddle_tpu.distributed.topology import get_hybrid_communicate_group
 
     assert get_hybrid_communicate_group() is hcg
+
+
+def test_distributed_gradient_merge_parity():
+    """K micro-batches with accumulate_steps=K == one K-times-larger
+    batch (mean-reduced loss), on the dp mesh — including ZeRO-2
+    sharded merge buffers."""
+    import paddle_tpu.nn as nn
+
+    def mk(stage):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        return net, opt
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, 8, 8).astype(np.float32)   # 4 micro-batches of 8
+    ys = rng.randint(0, 2, (4, 8))
+
+    hcg = HybridCommunicateGroup(dp=2, sharding=2)
+    set_hybrid_communicate_group(hcg)
+
+    # merged: 4 micro-batches, update on the 4th
+    net_m, opt_m = mk(2)
+    step_m = dist.DistributedTrainStep(net_m, opt_m, lambda o, l:
+                                  F.cross_entropy(o, l),
+                                  sharding_stage=2, accumulate_steps=4)
+    for i in range(4):
+        step_m(paddle.to_tensor(xs[i]), label=paddle.to_tensor(ys[i]))
+    assert opt_m._step_count == 1
+
+    # reference: ONE batch of 32 (same samples), one update
+    net_r, opt_r = mk(2)
+    step_r = dist.DistributedTrainStep(net_r, opt_r, lambda o, l:
+                                  F.cross_entropy(o, l),
+                                  sharding_stage=2)
+    step_r(paddle.to_tensor(xs.reshape(32, 8)),
+           label=paddle.to_tensor(ys.reshape(32)))
+
+    for pm, pr in zip(net_m.parameters(), net_r.parameters()):
+        np.testing.assert_allclose(np.asarray(pm._array),
+                                   np.asarray(pr._array),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_gradient_merge_sum_mode():
+    """accumulate_avg=False applies the SUM of the K micro-grads
+    (GradientMergeOptimizer avg=False parity)."""
+    import paddle_tpu.nn as nn
+
+    def run(avg, lr):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=lr,
+                                   parameters=net.parameters())
+        step = dist.DistributedTrainStep(
+            net, opt, F.cross_entropy, accumulate_steps=2,
+            accumulate_avg=avg)
+        rng = np.random.RandomState(0)
+        for i in range(2):
+            step(paddle.to_tensor(rng.randn(8, 4).astype(np.float32)),
+                 label=paddle.to_tensor(rng.randint(0, 2, (8,))))
+        return [np.asarray(p._array) for p in net.parameters()]
+
+    set_hybrid_communicate_group(HybridCommunicateGroup(dp=2))
+    # sum at lr == mean at 2*lr
+    p_sum = run(False, 0.05)
+    p_avg = run(True, 0.10)
+    for a, b in zip(p_sum, p_avg):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)

@@ -215,3 +215,11 @@ def test_ops_accept_name_kwarg():
     paddle.lgamma(x, name="lg")
     paddle.frac(x, name="f")
     paddle.abs(x, name="a")
+
+
+def test_take_invalid_mode_and_trapezoid_xor():
+    x = _t(np.arange(4, dtype=np.float32))
+    with pytest.raises(ValueError, match="invalid mode"):
+        paddle.take(x, np.array([0]), mode="rise")
+    with pytest.raises(ValueError, match="not both"):
+        paddle.trapezoid(x, x=_t(np.arange(4, dtype=np.float32)), dx=0.5)

@@ -9,6 +9,7 @@ from jax.sharding import PartitionSpec
 
 from . import (auto_parallel, fleet, functional, moe, mp_layers, pipeline,
                ps, ring_attention, rpc, sharding)
+from .localsgd import LocalSGD
 from .spawn import spawn
 from .pipeline import (
     LayerDesc,

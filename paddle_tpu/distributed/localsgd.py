@@ -1,0 +1,105 @@
+"""LocalSGD — analog of the reference's localsgd meta-optimizer
+(fleet/meta_optimizers/localsgd_optimizer.py, dygraph edition): each
+data-parallel worker takes k local optimizer steps on its own gradients,
+then parameters are averaged across the dp group. Communication drops by
+k× at the cost of staleness — the DCN-friendly strategy when workers
+are linked by slow fabric.
+
+TPU-native placement: within one SPMD program dp gradients are already
+globally reduced per step (there is nothing to localize), so LocalSGD
+lives at the MULTI-PROCESS tier: local steps run the plain optimizer,
+and the periodic sync is one eager cross-process all_reduce per
+parameter (collective.py). With one process it degrades to the inner
+optimizer exactly.
+
+Adaptive variant (adaptive_localsgd): the sync interval grows as the
+loss falls (Lin et al. 2018's step-wise schedule), capped by max_k.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["LocalSGD"]
+
+
+class LocalSGD:
+    """Wrap any optimizer:
+
+        opt = LocalSGD(paddle.optimizer.SGD(...), k_steps=4)
+        loss.backward(); opt.step(); opt.clear_grad()
+
+    Every k_steps-th step() triggers the parameter average across the
+    dp group."""
+
+    def __init__(self, optimizer, k_steps: int = 1, group=None,
+                 adaptive: bool = False, init_k_steps: Optional[int] = None,
+                 max_k_steps: int = 16):
+        if int(k_steps) < 1:
+            raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+        self._inner = optimizer
+        self.k_steps = int(init_k_steps if adaptive and init_k_steps
+                           else k_steps)
+        self.group = group
+        self.adaptive = bool(adaptive)
+        self.max_k_steps = int(max_k_steps)
+        self._local = 0
+        self._best_loss = None
+
+    # -- delegation (optimizer surface) ------------------------------------
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def clear_grad(self, *a, **kw):
+        return self._inner.clear_grad(*a, **kw)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Must route through THIS step() (the inner minimize would
+        bypass the k-step sync entirely)."""
+        loss.backward()
+        self.step(loss)
+        self.clear_grad()
+
+    def state_dict(self):
+        d = self._inner.state_dict()
+        d["localsgd"] = {"k_steps": self.k_steps, "local": self._local}
+        return d
+
+    def set_state_dict(self, state):
+        meta = dict(state).pop("localsgd", None)
+        self._inner.set_state_dict(
+            {k: v for k, v in state.items() if k != "localsgd"})
+        if meta:
+            self.k_steps = int(meta.get("k_steps", self.k_steps))
+            self._local = int(meta.get("local", 0))
+
+    # -- the strategy ------------------------------------------------------
+    def step(self, loss=None):
+        self._inner.step()
+        self._local += 1
+        if self.adaptive and loss is not None:
+            self._adapt(float(loss))
+        if self._local >= self.k_steps:
+            self.sync_params()
+            self._local = 0
+
+    def _adapt(self, loss):
+        """Grow the interval when the loss has improved (train is in a
+        flat, communication-tolerant regime); shrink it when the loss
+        regresses."""
+        if self._best_loss is None or loss < self._best_loss:
+            self._best_loss = loss if self._best_loss is None else \
+                min(self._best_loss, loss)
+            self.k_steps = min(self.k_steps * 2, self.max_k_steps)
+        else:
+            self.k_steps = max(self.k_steps // 2, 1)
+
+    def sync_params(self):
+        """Average parameters across the dp group (one eager AVG
+        all_reduce per param; no-op with world size 1)."""
+        from . import collective as C
+
+        if len(C._member_ranks(self.group)) <= 1:
+            return
+        for p in self._inner._parameter_list:
+            C.all_reduce(p, op=C.ReduceOp.AVG, group=self.group)

@@ -101,6 +101,37 @@ def run_train(dist, paddle, rank, world, out_file):
     print("ok train", losses, flush=True)
 
 
+def run_localsgd(dist, paddle, rank, world, out_file):
+    """LocalSGD 2-process: ranks train on DIFFERENT local batches for
+    k=2 local steps, then params average; after each sync both ranks
+    must hold identical parameters."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.localsgd import LocalSGD
+
+    paddle.seed(0)  # same init everywhere (broadcast analog)
+    net = nn.Linear(6, 3)
+    opt = LocalSGD(paddle.optimizer.SGD(learning_rate=0.1,
+                                        parameters=net.parameters()),
+                   k_steps=2)
+    rng = np.random.RandomState(100 + rank)  # per-rank local data
+    for i in range(4):
+        x = paddle.to_tensor(rng.randn(8, 6).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 3, (8,)))
+        F.cross_entropy(net(x), y).backward()
+        opt.step()
+        opt.clear_grad()
+    # 4 steps / k=2 -> 2 syncs; the last step ended ON a sync boundary
+    w = np.asarray(net.weight._array)
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(w))
+    check("localsgd_params_equal", gathered[0]._array, gathered[1]._array)
+    if rank == 0 and out_file:
+        with open(out_file, "w") as f:
+            json.dump({"ok": True}, f)
+    print("ok localsgd", flush=True)
+
+
 def run_ps(dist, paddle, rank, world):
     """2-process PS: each host owns id%2 rows; pulls/pushes for remote
     ids ride the alltoall (the distributed_lookup/push_sparse path)."""
@@ -349,6 +380,9 @@ def main():
     if phase in ("all", "epcp"):
         run_epcp(dist, paddle, rank, world,
                  out_file if phase == "epcp" else None)
+    if phase in ("all", "localsgd"):
+        run_localsgd(dist, paddle, rank, world,
+                     out_file if phase == "localsgd" else None)
     print("WORKER_DONE", flush=True)
 
 

@@ -220,3 +220,39 @@ def test_onnx_export_gated():
         pass
     with pytest.raises(ImportError, match="StableHLO"):
         paddle.onnx.export(_mlp(), "/tmp/x")
+
+
+# -- timeline merge tool -------------------------------------------------
+def test_merge_timelines_tool(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    import paddle_tpu
+
+    for r in range(2):
+        prof = paddle_tpu.profiler.Profiler()
+        prof.start()
+        with paddle_tpu.profiler.RecordEvent(f"work_r{r}"):
+            paddle_tpu.to_tensor(np.ones(4, np.float32)).sum()
+        prof.stop()
+        prof.export(str(tmp_path / f"rank{r}.json"))
+
+    out = str(tmp_path / "merged.json")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "merge_timelines.py")
+    res = subprocess.run(
+        [sys.executable, tool, "-o", out,
+         str(tmp_path / "rank0.json"), str(tmp_path / "rank1.json")],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    pids = {e["pid"] for e in evs if "pid" in e}
+    # the two ranks keep disjoint pid namespaces
+    assert any(p >= 200000 for p in pids) and any(
+        100000 <= p < 200000 for p in pids)
+    names = {e.get("args", {}).get("name") for e in evs
+             if e.get("ph") == "M"}
+    assert any(n and n.startswith("rank0") for n in names)
+    assert any("work_r1" == e.get("name") for e in evs)

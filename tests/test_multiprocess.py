@@ -222,3 +222,13 @@ def test_launcher_propagates_failure(tmp_path):
          "--nprocs", "2", "--backend", "cpu", str(bad)],
         env=env, capture_output=True, text=True, timeout=120)
     assert res.returncode == 3
+
+
+def test_two_process_localsgd():
+    """LocalSGD: per-rank local steps on different data, periodic
+    parameter averaging — ranks converge to identical params at every
+    sync boundary (localsgd_optimizer.py dygraph analog)."""
+    res = _launch("localsgd")
+    assert res.returncode == 0, f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert res.stdout.count("ok localsgd\n") == 2
+    assert res.stdout.count("ok localsgd_params_equal") == 2

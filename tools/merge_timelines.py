@@ -1,0 +1,81 @@
+"""Merge per-host/per-rank chrome traces into one timeline — analog of
+the reference's tools/CrossStackProfiler/ (multi-node timeline merge).
+
+Each input is a chrome-trace JSON written by
+paddle_tpu.profiler.Profiler.export (or jax's trace viewer dump). The
+merge namespaces every input's pids (chrome dedupes colliding pids
+across hosts, silently interleaving unrelated processes) and labels
+them with process_name metadata so the trace viewer shows one row group
+per rank.
+
+    python tools/merge_timelines.py -o merged.json \
+        rank0/trace.json rank1/trace.json
+    python tools/merge_timelines.py -o merged.json 'profiles/*.json'
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_events(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # bare event-array flavor
+        return doc
+    return doc.get("traceEvents", [])
+
+
+def merge(paths, align_start=False):
+    merged = []
+    for slot, path in enumerate(paths):
+        events = load_events(path)
+        label = os.path.splitext(os.path.basename(path))[0]
+        # per-input pid namespace: slot*100000 + original pid % 100000
+        base = (slot + 1) * 100000
+        pids = {}
+        t0 = min((e["ts"] for e in events if "ts" in e), default=0)
+        for e in events:
+            e = dict(e)
+            if "pid" in e:
+                pid = e["pid"]
+                if pid not in pids:
+                    pids[pid] = base + (len(pids) % 100000)
+                e["pid"] = pids[pid]
+            if align_start and "ts" in e:
+                e["ts"] = e["ts"] - t0
+            merged.append(e)
+        for orig, new in pids.items():
+            merged.append({"name": "process_name", "ph": "M", "pid": new,
+                           "args": {"name": f"{label} (pid {orig})"}})
+    return merged
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+",
+                    help="trace files or globs, one per rank/host")
+    ap.add_argument("-o", "--output", required=True)
+    ap.add_argument("--align-start", action="store_true",
+                    help="shift every input so its first event is t=0 "
+                         "(hosts without synced clocks)")
+    args = ap.parse_args(argv)
+    paths = []
+    for pat in args.traces:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        sys.exit(f"trace file(s) not found: {missing}")
+    events = merge(paths, align_start=args.align_start)
+    with open(args.output, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    print(f"merged {len(paths)} traces ({len(events)} events) "
+          f"-> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,20 @@ def _install_tensor_methods():
         ("cholesky", lg.cholesky), ("inverse", lg.inverse),
         # activation-ish
         ("softmax", act.softmax), ("sigmoid", act.sigmoid), ("relu", act.relu),
+        # op-parity batch (special fns / complex / index / misc)
+        ("frac", m.frac), ("lgamma", m.lgamma), ("digamma", m.digamma),
+        ("conj", m.conj), ("real", m.real), ("imag", m.imag),
+        ("angle", m.angle), ("sgn", m.sgn), ("logit", m.logit),
+        ("erfinv", m.erfinv), ("expm1", m.expm1), ("fmax", m.fmax),
+        ("fmin", m.fmin), ("remainder", m.remainder), ("fmod", m.fmod),
+        ("copysign", m.copysign), ("hypot", m.hypot),
+        ("isclose", m.isclose), ("allclose", m.allclose),
+        ("equal_all", m.equal_all), ("multiply_", m.multiply_),
+        ("take", mp.take), ("diff", mp.diff), ("swapaxes", mp.swapaxes),
+        ("swapdims", mp.swapdims),
+        ("as_strided", mp.as_strided), ("bucketize", mp.bucketize),
+        ("nanmedian", r.nanmedian), ("trapezoid", r.trapezoid),
+        ("cov", lg.cov), ("corrcoef", lg.corrcoef),
     ]:
         setattr(T, name, fn)
 

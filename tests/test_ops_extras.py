@@ -223,3 +223,15 @@ def test_take_invalid_mode_and_trapezoid_xor():
         paddle.take(x, np.array([0]), mode="rise")
     with pytest.raises(ValueError, match="not both"):
         paddle.trapezoid(x, x=_t(np.arange(4, dtype=np.float32)), dx=0.5)
+
+
+def test_new_ops_available_as_tensor_methods():
+    x = _t(np.array([1.7, -0.3], np.float32))
+    np.testing.assert_allclose(x.frac().numpy(), [0.7, -0.3], rtol=1e-5)
+    np.testing.assert_allclose(
+        x.hypot(_t(np.array([1.0, 1.0], np.float32))).numpy(),
+        np.hypot([1.7, -0.3], 1.0), rtol=1e-5)
+    m = _t(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert m.take(np.array([5])).numpy()[0] == 5
+    assert m.swapaxes(0, 1).shape == [4, 3]
+    assert bool(m.allclose(m).numpy())

@@ -2,8 +2,10 @@ from .lenet import LeNet
 from .mobilenetv2 import MobileNetV2, mobilenet_v2
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .vgg import VGG, AlexNet, alexnet, vgg11, vgg13, vgg16, vgg19
+from .yolo import PPYOLOELite, ppyoloe_lite, yolo_loss, yolo_postprocess
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "MobileNetV2", "mobilenet_v2",
            "VGG", "vgg11", "vgg13", "vgg16", "vgg19", "AlexNet",
-           "alexnet"]
+           "alexnet", "PPYOLOELite", "ppyoloe_lite", "yolo_loss",
+           "yolo_postprocess"]

@@ -1,6 +1,7 @@
 """paddle.nn.functional analog — re-exports the functional op surface
 (python/paddle/nn/functional/)."""
 from paddle_tpu.ops.activation import (
+    rrelu, thresholded_relu,
     celu, elu, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid, hardswish,
     hardtanh, leaky_relu, log_sigmoid, log_softmax, maxout, mish, prelu, relu,
     relu6, selu, sigmoid, silu, softmax, softplus, softshrink, softsign,
@@ -17,7 +18,11 @@ from paddle_tpu.ops.nn_ops import (
     l1_loss, label_smooth, layer_norm, linear, margin_ranking_loss,
     max_pool1d, max_pool2d, mse_loss, nll_loss, pixel_shuffle, rms_norm,
     scaled_dot_product_attention, smooth_l1_loss, softmax_with_cross_entropy,
-    temporal_shift, unfold,
+    temporal_shift, unfold, fold, max_pool3d, avg_pool3d, normalize,
+    local_response_norm, dropout3d, alpha_dropout, pixel_unshuffle,
+    sequence_mask, square_error_cost, log_loss, sigmoid_focal_loss,
+    dice_loss, npair_loss, triplet_margin_loss, cosine_embedding_loss,
+    margin_cross_entropy, ctc_loss,
 )
 
 binary_cross_entropy = bce_loss

@@ -158,7 +158,8 @@ class LocalResponseNorm(Layer):
             summed = jax.lax.reduce_window(
                 sq, 0.0, jax.lax.add, (1, size, 1, 1), (1, 1, 1, 1),
                 padding=[(0, 0), (half, size - 1 - half), (0, 0), (0, 0)])
-            return a / jnp.power(k + alpha * summed, beta)
+            # paddle divides the window sum by size (avg-pool form)
+            return a / jnp.power(k + alpha * summed / size, beta)
 
         return apply("lrn", fn, x)
 

@@ -15,6 +15,7 @@ __all__ = [
     "softshrink", "tanhshrink", "softplus", "softsign", "mish", "prelu",
     "log_sigmoid", "softmax", "log_softmax", "gumbel_softmax", "maxout",
     "glu", "tanh",
+    "thresholded_relu", "rrelu",
 ]
 
 
@@ -178,3 +179,29 @@ def maxout(x, groups, axis=1):
 def glu(x, axis=-1):
     x = as_tensor(x)
     return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    x = as_tensor(x)
+    return apply("thresholded_relu",
+                 lambda a: jnp.where(a > threshold, a, 0.0), x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    """Randomized leaky relu: train draws the negative slope uniformly
+    per element; eval uses the mean slope (functional/activation.py)."""
+    from paddle_tpu.core import random as random_mod
+
+    x = as_tensor(x)
+    if not training:
+        mid = (lower + upper) / 2.0
+        return apply("rrelu",
+                     lambda a: jnp.where(a >= 0, a, mid * a), x)
+    key = random_mod.next_key()
+
+    def fn(a):
+        slope = jax.random.uniform(key, a.shape, minval=lower,
+                                   maxval=upper).astype(a.dtype)
+        return jnp.where(a >= 0, a, slope * a)
+
+    return apply("rrelu", fn, x)

@@ -33,7 +33,12 @@ __all__ = [
     "kl_div", "cosine_similarity", "margin_ranking_loss", "hinge_embedding_loss",
     "scaled_dot_product_attention", "interpolate", "pixel_shuffle",
     "fused_bias_dropout_residual_layer_norm", "label_smooth", "temporal_shift",
-    "unfold", "grid_sample", "affine_grid",
+    "unfold", "fold", "grid_sample", "affine_grid",
+    "max_pool3d", "avg_pool3d", "normalize", "local_response_norm",
+    "dropout3d", "alpha_dropout", "pixel_unshuffle", "sequence_mask",
+    "square_error_cost", "log_loss", "sigmoid_focal_loss", "dice_loss",
+    "npair_loss", "triplet_margin_loss", "cosine_embedding_loss",
+    "margin_cross_entropy", "ctc_loss",
 ]
 
 
@@ -1181,3 +1186,299 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
         return out
 
     return apply("fold", fn, x)
+
+
+# ---------------------------------------------------------------------------
+# functional-surface completion (losses + misc; python/paddle/nn/functional/)
+# ---------------------------------------------------------------------------
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    """L_p normalize along `axis` (functional/norm.py normalize)."""
+    x = as_tensor(x)
+
+    def fn(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply("normalize", fn, x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        half = size // 2
+        summed = jax.lax.reduce_window(
+            jnp.square(a), 0.0, jax.lax.add, (1, size, 1, 1), (1, 1, 1, 1),
+            padding=[(0, 0), (half, size - 1 - half), (0, 0), (0, 0)])
+        # paddle divides the window sum by size (avg-pool formulation)
+        return a / jnp.power(k + alpha * summed / size, beta)
+
+    return apply("local_response_norm", fn, x)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Channel-wise dropout for 5-D inputs (whole [D,H,W] blocks) —
+    dropout2d's pattern, one more spatial dim."""
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, training, axis=axis)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (functional/common.py alpha_dropout):
+    dropped units take the negative saturation value alpha' and the
+    output is affinely rescaled a*x+b with
+    a = ((1-p)(1 + p*alpha'^2))^-1/2 (Klambauer et al. 2017, keeps
+    zero mean / unit variance under SELU statistics)."""
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a_coef = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+    key = random_mod.next_key()
+
+    def fn(t):
+        keep = jax.random.bernoulli(key, 1.0 - p, t.shape)
+        return (a_coef * jnp.where(keep, t, alpha_p) + b_coef) \
+            .astype(t.dtype)
+
+    return apply("alpha_dropout", fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """Inverse of pixel_shuffle: [B,C,H,W] -> [B,C*r^2,H/r,W/r]."""
+    x = as_tensor(x)
+    r = int(downscale_factor)
+
+    def fn(a):
+        B, C, H, W = a.shape
+        a = a.reshape(B, C, H // r, r, W // r, r)
+        return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+            B, C * r * r, H // r, W // r)
+
+    return apply("pixel_unshuffle", fn, x)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="bool", name=None):
+    """mask[i, t] = t < lengths[i] (functional sequence_mask)."""
+    from paddle_tpu.core import dtype as dtypes
+
+    lengths = as_tensor(lengths)
+    if maxlen is None and isinstance(lengths._array, jax.core.Tracer):
+        raise ValueError(
+            "sequence_mask: maxlen is required under jit (the output "
+            "shape would depend on traced values)")
+    ml = int(maxlen) if maxlen is not None else \
+        int(np.asarray(lengths._array).max())
+    jd = dtypes.to_jax(dtype)
+    return apply_nograd(
+        "sequence_mask",
+        lambda l: (jnp.arange(ml)[None, :] < l[..., None]).astype(jd),
+        lengths)
+
+
+def square_error_cost(input, label):
+    input, label = as_tensor(input), as_tensor(label)
+    return apply("square_error_cost", lambda a, b: (a - b) ** 2,
+                 input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    return apply(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) -
+        (1.0 - y) * jnp.log(1.0 - p + epsilon), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """Focal loss on logits (functional/loss.py sigmoid_focal_loss)."""
+    logit, label = as_tensor(logit), as_tensor(label)
+    norm_arr = None if normalizer is None else as_tensor(normalizer)
+
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = (logit, label) + ((norm_arr,) if norm_arr is not None else ())
+    return apply("sigmoid_focal_loss", fn, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - Dice coefficient over the trailing class dim
+    (functional/loss.py dice_loss): input [N,...,C] probs, label
+    [N,...,1] int."""
+    input = as_tensor(input)
+    label = as_tensor(label)
+
+    def fn(p, y):
+        C = p.shape[-1]
+        oh = jax.nn.one_hot(y.squeeze(-1), C, dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", fn, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Multi-class n-pair loss (functional/loss.py npair_loss)."""
+    anchor, positive = as_tensor(anchor), as_tensor(positive)
+    labels = as_tensor(labels)
+
+    def fn(a, p, y):
+        sim = a @ p.T  # [B,B]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / same.sum(axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -same * jax.nn.log_softmax(sim, axis=1), axis=1))
+        # reference weights the l2 term by 0.25
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return xent + reg
+
+    return apply("npair_loss", fn, anchor, positive, labels)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    input, positive, negative = (as_tensor(input), as_tensor(positive),
+                                 as_tensor(negative))
+
+    def fn(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p + epsilon, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p + epsilon, axis=-1) ** (1 / p)
+        if swap:
+            dpn = jnp.sum(jnp.abs(pos - neg) ** p + epsilon,
+                          axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dpn)
+        loss = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce_loss(loss, reduction)
+
+    return apply("triplet_margin_loss", fn, input, positive, negative)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    """label=1: pull together (1-cos); label=-1: push below margin."""
+    input1, input2, label = (as_tensor(input1), as_tensor(input2),
+                             as_tensor(label))
+
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1),
+            1e-12)
+        loss = jnp.where(y > 0, 1.0 - cos,
+                         jnp.maximum(cos - margin, 0.0))
+        return _reduce_loss(loss, reduction)
+
+    return apply("cosine_embedding_loss", fn, input1, input2, label)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (functional margin_cross_entropy):
+    logits are cosines; the target class angle gets margins
+    cos(m1*θ + m2) - m3 before scaled softmax CE. (The reference's
+    model-parallel group sharding is subsumed by running it under a
+    pjit step with mp-sharded logits.)"""
+    logits, label = as_tensor(logits), as_tensor(label)
+
+    def fn(z, y):
+        C = z.shape[-1]
+        oh = jax.nn.one_hot(y, C, dtype=z.dtype)
+        theta = jnp.arccos(jnp.clip(z, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = z * (1 - oh) + target * oh
+        logp = jax.nn.log_softmax(scale * adj, axis=-1)
+        loss = _reduce_loss(-jnp.sum(oh * logp, axis=-1), reduction)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return apply("margin_cross_entropy", fn, logits, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (functional/loss.py ctc_loss; phi warpctc analog) via
+    the log-domain forward algorithm as ONE lax.scan over time — the
+    TPU-native replacement for warp-ctc's CUDA kernels. log_probs
+    [T,B,C] (time-major, like paddle), labels [B,S] int, returns the
+    negative log-likelihood per sample (reduced)."""
+    log_probs = as_tensor(log_probs)
+    labels_t = as_tensor(labels)
+    in_len = as_tensor(input_lengths)
+    lab_len = as_tensor(label_lengths)
+
+    def fn(lp, lab, T_len, S_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)  # idempotent on log-probs
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        L = 2 * S + 1  # blank-interleaved target length
+        NEG = -1e30
+
+        # extended labels: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, L), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        # alpha recurrence allows skip (i-2) when ext[i] != ext[i-2]
+        # and ext[i] != blank
+        ext_prev2 = jnp.pad(ext, ((0, 0), (2, 0)),
+                            constant_values=blank)[:, :L]
+        can_skip = (ext != blank) & (ext != ext_prev2)
+
+        def emit(t_lp, idx):
+            return jnp.take_along_axis(t_lp, idx, axis=-1)
+
+        alpha0 = jnp.full((B, L), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(lp[0], ext[:, :1])[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(S_len > 0, emit(lp[0], ext[:, 1:2])[:, 0], NEG))
+
+        def step(alpha, t_lp):
+            a_prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                              constant_values=NEG)[:, :L]
+            a_prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                              constant_values=NEG)[:, :L]
+            merged = jnp.logaddexp(alpha, a_prev1)
+            merged = jnp.where(can_skip,
+                               jnp.logaddexp(merged, a_prev2), merged)
+            return merged + emit(t_lp, ext), None
+
+        def body(carry, t):
+            alpha, = carry
+            new, _ = step(alpha, lp[t])
+            # freeze past each sample's input length
+            new = jnp.where((t < T_len)[:, None], new, alpha)
+            return (new,), None
+
+        (alpha,), _ = jax.lax.scan(body, (alpha0,),
+                                   jnp.arange(1, T))
+        # NLL = -log(alpha[last blank] + alpha[last label])
+        last = 2 * S_len  # index of final blank
+        aN = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        aN1 = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        nll = -jnp.logaddexp(aN, jnp.where(S_len > 0, aN1, NEG))
+        if norm_by_times:
+            nll = nll / T_len.astype(nll.dtype)
+        if reduction == "mean":
+            # paddle normalizes each sample by its label length first
+            return (nll / jnp.maximum(S_len, 1).astype(nll.dtype)).mean()
+        return _reduce_loss(nll, reduction)
+
+    return apply("ctc_loss", fn, log_probs, labels_t, in_len, lab_len)

@@ -105,8 +105,10 @@ class PPYOLOELite(nn.Layer):
                 .astype(np.float32))
             strides = paddle.to_tensor(
                 np.full((h * w_,), float(stride), np.float32))
-            if isinstance(pts._array, jax.core.Tracer) or \
-                    isinstance(jax.numpy.zeros(()), jax.core.Tracer):
+            # empirically, jnp constant creation under this jax
+            # version's jit trace yields DynamicJaxprTracers — caching
+            # one escapes the trace (UnexpectedTracerError on reuse)
+            if isinstance(pts._array, jax.core.Tracer):
                 return pts, strides  # trace-scoped: don't cache
             cache[key] = (pts, strides)
         return cache[key]

@@ -1,0 +1,147 @@
+"""Functional-surface completion tests (python/paddle/nn/functional/):
+the loss family, misc tensor utilities, and CTC — each against a numpy
+or analytic reference.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RS = np.random.RandomState(0)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_normalize_and_sequence_mask():
+    x = RS.randn(4, 8).astype(np.float32)
+    out = F.normalize(_t(x), p=2, axis=1).numpy()
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0,
+                               rtol=1e-5)
+    m = F.sequence_mask(_t(np.array([1, 3])), maxlen=4).numpy()
+    np.testing.assert_array_equal(
+        m, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_simple_losses_match_references():
+    p = RS.rand(8).astype(np.float32) * 0.9 + 0.05
+    y = (RS.rand(8) > 0.5).astype(np.float32)
+    np.testing.assert_allclose(
+        F.log_loss(_t(p), _t(y)).numpy(),
+        -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4),
+        rtol=1e-5)
+    a = RS.randn(6).astype(np.float32)
+    b = RS.randn(6).astype(np.float32)
+    np.testing.assert_allclose(
+        F.square_error_cost(_t(a), _t(b)).numpy(), (a - b) ** 2,
+        rtol=1e-6)
+
+
+def test_sigmoid_focal_loss_gamma_zero_is_weighted_bce():
+    z = RS.randn(8).astype(np.float32)
+    y = (RS.rand(8) > 0.5).astype(np.float32)
+    ours = F.sigmoid_focal_loss(_t(z), _t(y), alpha=0.5, gamma=0.0,
+                                reduction="none").numpy()
+    p = 1 / (1 + np.exp(-z))
+    bce = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+    np.testing.assert_allclose(ours, 0.5 * bce, rtol=1e-4, atol=1e-5)
+
+
+def test_dice_loss_perfect_prediction_is_small():
+    y = RS.randint(0, 3, (2, 5, 1))
+    perfect = np.eye(3, dtype=np.float32)[y.squeeze(-1)]
+    loss = float(F.dice_loss(_t(perfect), _t(y)).numpy())
+    assert loss < 0.01
+    uniform = np.full((2, 5, 3), 1 / 3, np.float32)
+    assert float(F.dice_loss(_t(uniform), _t(y)).numpy()) > loss
+
+
+def test_triplet_and_cosine_embedding_losses():
+    a = RS.randn(4, 8).astype(np.float32)
+    # positive == anchor, negative far: loss should be ~0 at margin 0
+    z = float(F.triplet_margin_loss(_t(a), _t(a), _t(a + 100), margin=0.0)
+              .numpy())
+    assert z < 1e-3
+    # cosine: identical vectors with label 1 -> ~0
+    y = np.ones((4,), np.float32)
+    c = float(F.cosine_embedding_loss(_t(a), _t(a), _t(y)).numpy())
+    assert c < 1e-5
+
+
+def test_margin_cross_entropy_reduces_target_logit():
+    cos = np.full((2, 4), 0.2, np.float32)
+    cos[0, 1] = 0.9
+    cos[1, 2] = 0.9
+    y = np.array([1, 2])
+    plain = float(F.margin_cross_entropy(
+        _t(cos), _t(y), margin1=1.0, margin2=0.0, margin3=0.0,
+        scale=10.0).numpy())
+    margined = float(F.margin_cross_entropy(
+        _t(cos), _t(y), margin1=1.0, margin2=0.5, margin3=0.0,
+        scale=10.0).numpy())
+    assert margined > plain  # margin makes the task harder
+
+
+def test_ctc_loss_against_bruteforce():
+    """T=3, C=3 (blank=0), label 'a': sum over all alignments mapping
+    to 'a' must equal exp(-nll)."""
+    T, B, C = 3, 1, 3
+    rng = np.random.RandomState(0)
+    logits = rng.randn(T, B, C).astype(np.float32)
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    nll = float(F.ctc_loss(_t(lp), _t(np.array([[1]])),
+                           _t(np.array([3])), _t(np.array([1])),
+                           reduction="none").numpy()[0])
+
+    # brute force: all 3^3 paths, collapse (remove blanks+repeats) == [1]
+    total = 0.0
+    import itertools
+
+    for path in itertools.product(range(C), repeat=T):
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != 0 and s != prev:
+                collapsed.append(s)
+            prev = s
+        if collapsed == [1]:
+            total += np.exp(sum(lp[t, 0, s] for t, s in enumerate(path)))
+    np.testing.assert_allclose(np.exp(-nll), total, rtol=1e-4)
+
+
+def test_ctc_loss_is_differentiable_and_batched():
+    T, B, C, S = 6, 3, 5, 2
+    rng = np.random.RandomState(1)
+    logits = _t(rng.randn(T, B, C).astype(np.float32))
+    logits.stop_gradient = False
+    labels = _t(rng.randint(1, C, (B, S)))
+    loss = F.ctc_loss(logits, labels, _t(np.array([6, 5, 4])),
+                      _t(np.array([2, 2, 1])))
+    assert np.isfinite(float(loss))
+    loss.backward()
+    assert logits.grad is not None
+    assert np.isfinite(np.asarray(logits.grad._array)).all()
+
+
+def test_misc_activations_and_pools():
+    x = RS.randn(2, 3, 4, 4).astype(np.float32)
+    out = F.pixel_unshuffle(_t(x), 2)
+    assert out.shape == [2, 12, 2, 2]
+    back = F.pixel_shuffle(out, 2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    t = _t(np.array([-1.0, 0.5, 2.0], np.float32))
+    np.testing.assert_allclose(F.thresholded_relu(t, 1.0).numpy(),
+                               [0, 0, 2], rtol=1e-6)
+    r_eval = F.rrelu(t, training=False).numpy()
+    mid = (1 / 8 + 1 / 3) / 2
+    np.testing.assert_allclose(r_eval, [-mid, 0.5, 2.0], rtol=1e-5)
+    x5 = RS.randn(1, 2, 4, 4, 4).astype(np.float32)
+    assert F.max_pool3d(_t(x5), 2).shape == [1, 2, 2, 2, 2]
+    d = F.dropout3d(_t(x5), p=0.5, training=True).numpy()
+    # whole channels are zeroed or scaled
+    per_chan = d.reshape(2, -1)
+    for c in range(2):
+        vals = per_chan[c]
+        assert (vals == 0).all() or not (vals == 0).any()

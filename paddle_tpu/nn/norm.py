@@ -145,23 +145,8 @@ class LocalResponseNorm(Layer):
         self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
 
     def forward(self, x):
-        import jax
-        import jax.numpy as jnp
-
-        from paddle_tpu.ops.dispatch import apply
-
-        size, alpha, beta, k = self.size, self.alpha, self.beta, self.k
-
-        def fn(a):
-            sq = jnp.square(a)
-            half = size // 2
-            summed = jax.lax.reduce_window(
-                sq, 0.0, jax.lax.add, (1, size, 1, 1), (1, 1, 1, 1),
-                padding=[(0, 0), (half, size - 1 - half), (0, 0), (0, 0)])
-            # paddle divides the window sum by size (avg-pool form)
-            return a / jnp.power(k + alpha * summed / size, beta)
-
-        return apply("lrn", fn, x)
+        return nn_ops.local_response_norm(
+            x, self.size, alpha=self.alpha, beta=self.beta, k=self.k)
 
 
 class SpectralNorm(Layer):

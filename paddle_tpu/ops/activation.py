@@ -197,6 +197,9 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
         mid = (lower + upper) / 2.0
         return apply("rrelu",
                      lambda a: jnp.where(a >= 0, a, mid * a), x)
+    from paddle_tpu.ops.nn_ops import _warn_if_constant_key
+
+    _warn_if_constant_key(x._array, "rrelu")
     key = random_mod.next_key()
 
     def fn(a):

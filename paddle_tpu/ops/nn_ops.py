@@ -545,6 +545,24 @@ def embedding(ids, weight, padding_idx=None, sparse=False):
 _dropout_trace_warned = False
 
 
+def _warn_if_constant_key(arr, opname):
+    """One-time warning shared by every op that draws a PRNG key at
+    trace time: outside a key scope the key is baked as a constant and
+    every execution reuses the same mask/noise."""
+    global _dropout_trace_warned
+    if isinstance(arr, jax.core.Tracer) and not random_mod.in_key_scope():
+        if not _dropout_trace_warned:
+            import warnings
+
+            warnings.warn(
+                f"{opname} traced with a constant PRNG key: every "
+                "execution of this compiled function will reuse the SAME "
+                "random draw. Use jit.TrainStep (which threads a per-step "
+                "key) or wrap the call in "
+                "paddle_tpu.core.random.key_scope(key).")
+            _dropout_trace_warned = True
+
+
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
     """Dropout. Analog of phi DropoutKernel. RNG comes from the global
     Generator key chain (core/random.py); inside a compiled step the key
@@ -554,17 +572,7 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
     x = as_tensor(x)
     if not training or p == 0.0:
         return x
-    if isinstance(x._array, jax.core.Tracer) and not random_mod.in_key_scope():
-        global _dropout_trace_warned
-        if not _dropout_trace_warned:
-            import warnings
-
-            warnings.warn(
-                "dropout traced with a constant PRNG key: every execution of "
-                "this compiled function will reuse the SAME dropout mask. "
-                "Use jit.TrainStep (which threads a per-step key) or wrap "
-                "the call in paddle_tpu.core.random.key_scope(key).")
-            _dropout_trace_warned = True
+    _warn_if_constant_key(x._array, "dropout")
     key = next_key()
     keep = 1.0 - p
 
@@ -1205,6 +1213,9 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        raise NotImplementedError(
+            f"local_response_norm: data_format={data_format!r}; NCHW only")
     x = as_tensor(x)
 
     def fn(a):
@@ -1239,6 +1250,7 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
     a_coef = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
     b_coef = -a_coef * p * alpha_p
+    _warn_if_constant_key(x._array, "alpha_dropout")
     key = random_mod.next_key()
 
     def fn(t):
@@ -1253,12 +1265,16 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
     """Inverse of pixel_shuffle: [B,C,H,W] -> [B,C*r^2,H/r,W/r]."""
     x = as_tensor(x)
     r = int(downscale_factor)
+    nhwc = data_format == "NHWC"
 
     def fn(a):
+        if nhwc:
+            a = a.transpose(0, 3, 1, 2)
         B, C, H, W = a.shape
         a = a.reshape(B, C, H // r, r, W // r, r)
-        return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+        out = a.transpose(0, 1, 3, 5, 2, 4).reshape(
             B, C * r * r, H // r, W // r)
+        return out.transpose(0, 2, 3, 1) if nhwc else out
 
     return apply("pixel_unshuffle", fn, x)
 
@@ -1359,12 +1375,14 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
                                  as_tensor(negative))
 
     def fn(a, pos, neg):
-        dp = jnp.sum(jnp.abs(a - pos) ** p + epsilon, axis=-1) ** (1 / p)
-        dn = jnp.sum(jnp.abs(a - neg) ** p + epsilon, axis=-1) ** (1 / p)
+        # epsilon once per distance (numerical floor), not per element —
+        # per-element would scale the "zero" distance with the feature dim
+        dist = lambda u, v: (jnp.sum(jnp.abs(u - v) ** p, axis=-1)
+                             + epsilon) ** (1 / p)
+        dp = dist(a, pos)
+        dn = dist(a, neg)
         if swap:
-            dpn = jnp.sum(jnp.abs(pos - neg) ** p + epsilon,
-                          axis=-1) ** (1 / p)
-            dn = jnp.minimum(dn, dpn)
+            dn = jnp.minimum(dn, dist(pos, neg))
         loss = jnp.maximum(dp - dn + margin, 0.0)
         return _reduce_loss(loss, reduction)
 

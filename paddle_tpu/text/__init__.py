@@ -85,3 +85,7 @@ class ViterbiDecoder(nn.Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+from . import datasets  # noqa: E402
+from .datasets import Conll05st, Imdb, UCIHousing  # noqa: E402

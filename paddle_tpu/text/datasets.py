@@ -1,0 +1,172 @@
+"""Text datasets — analog of python/paddle/text/datasets/ (Imdb,
+Conll05st, UCIHousing, ...). Zero-egress build: parsers read LOCAL
+files in the published formats; download=True raises (the same policy
+as vision/datasets).
+
+- Imdb: aclImdb-style tar.gz (train/{pos,neg}/*.txt), tokenized to a
+  frequency-cutoff vocabulary, yields (ids [seq], label).
+- Conll05st: tab/space column files (word ... label per line, blank
+  line between sentences), yields (word_ids, label_ids).
+- UCIHousing: whitespace 14-column regression rows, feature-normalized.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["Imdb", "Conll05st", "UCIHousing"]
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+def _no_download(download):
+    if download:
+        raise RuntimeError(
+            "this build has no network egress; place the dataset archive "
+            "locally and pass data_file=... (download=False)")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (text/datasets/imdb.py parity): `data_file` is an
+    aclImdb-layout tar.gz; `mode` picks the train/test subtree. Builds
+    the vocabulary from the TRAIN split (cutoff by min frequency) and
+    encodes each review as int64 ids (unk = len(vocab))."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=1,
+                 download=False, seq_len=None):
+        _no_download(download)
+        if not data_file or not os.path.exists(data_file):
+            raise FileNotFoundError(f"Imdb data_file not found: {data_file}")
+        self.mode = mode
+        self.seq_len = seq_len
+        texts = {"train": [], "test": []}
+        labels = {"train": [], "test": []}
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                parts = m.name.split("/")
+                # .../{train,test}/{pos,neg}/xxx.txt
+                if len(parts) < 4 or not m.name.endswith(".txt"):
+                    continue
+                split, pol = parts[-3], parts[-2]
+                if split not in texts or pol not in ("pos", "neg"):
+                    continue
+                raw = tf.extractfile(m).read().decode("utf-8", "ignore")
+                texts[split].append(
+                    [t.lower() for t in _TOKEN_RE.findall(raw)])
+                labels[split].append(0 if pol == "neg" else 1)
+        freq = {}
+        for toks in texts["train"]:
+            for t in toks:
+                freq[t] = freq.get(t, 0) + 1
+        vocab_tokens = sorted(t for t, c in freq.items() if c > cutoff)
+        self.word_idx = {t: i for i, t in enumerate(vocab_tokens)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [
+            np.asarray([self.word_idx.get(t, unk) for t in toks],
+                       np.int64)
+            for toks in texts[mode]]
+        self.labels = np.asarray(labels[mode], np.int64)
+
+    def __getitem__(self, i):
+        ids = self.docs[i]
+        if self.seq_len is not None:  # pad/trim to fixed length (XLA)
+            out = np.full((self.seq_len,), self.word_idx["<unk>"],
+                          np.int64)
+            n = min(len(ids), self.seq_len)
+            out[:n] = ids[:n]
+            ids = out
+        return ids, self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Conll05st(Dataset):
+    """CoNLL-style column dataset (text/datasets/conll05.py parity,
+    simplified to the word/label columns): `data_file` has one
+    "word label" pair per line, blank lines separate sentences."""
+
+    def __init__(self, data_file=None, download=False, seq_len=None):
+        _no_download(download)
+        if not data_file or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"Conll05st data_file not found: {data_file}")
+        sents, tags = [], []
+        cur_w, cur_t = [], []
+        with open(data_file) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    if cur_w:
+                        sents.append(cur_w)
+                        tags.append(cur_t)
+                        cur_w, cur_t = [], []
+                    continue
+                cols = line.split()
+                cur_w.append(cols[0].lower())
+                cur_t.append(cols[-1])
+        if cur_w:
+            sents.append(cur_w)
+            tags.append(cur_t)
+        words = sorted({w for s in sents for w in s})
+        labels = sorted({t for s in tags for t in s})
+        self.word_dict = {w: i for i, w in enumerate(words)}
+        self.word_dict["<unk>"] = len(self.word_dict)
+        self.label_dict = {t: i for i, t in enumerate(labels)}
+        # dedicated pad label id — padding must not alias a real class
+        self.label_dict["<pad>"] = len(self.label_dict)
+        self.seq_len = seq_len
+        self._data = [
+            (np.asarray([self.word_dict[w] for w in s], np.int64),
+             np.asarray([self.label_dict[t] for t in ts], np.int64))
+            for s, ts in zip(sents, tags)]
+
+    def __getitem__(self, i):
+        ids, labs = self._data[i]
+        if self.seq_len is not None:
+            unk = self.word_dict["<unk>"]
+            out_i = np.full((self.seq_len,), unk, np.int64)
+            out_l = np.full((self.seq_len,),
+                            self.label_dict["<pad>"], np.int64)
+            n = min(len(ids), self.seq_len)
+            out_i[:n] = ids[:n]
+            out_l[:n] = labs[:n]
+            return out_i, out_l
+        return ids, labs
+
+    def __len__(self):
+        return len(self._data)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing-format regression rows (text/datasets/
+    uci_housing.py parity): 14 whitespace columns, features normalized
+    to zero mean / unit std over the file, last column is the target."""
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        _no_download(download)
+        if not data_file or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"UCIHousing data_file not found: {data_file}")
+        rows = np.loadtxt(data_file).astype(np.float32)
+        if rows.ndim == 1:
+            rows = rows[None]
+        x = rows[:, :-1]
+        mu, sd = x.mean(axis=0), x.std(axis=0) + 1e-8
+        x = (x - mu) / sd
+        split = int(len(rows) * 0.8)
+        sl = np.s_[:split] if mode == "train" else np.s_[split:]
+        self.x = x[sl]
+        self.y = rows[:, -1:][sl]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)

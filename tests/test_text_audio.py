@@ -5,6 +5,8 @@ features against scipy.signal / closed-form DSP references.
 """
 import itertools
 
+import os
+
 import numpy as np
 import pytest
 from scipy import signal as spsignal
@@ -138,3 +140,69 @@ def test_fbank_rows_nonzero():
     fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=40))
     assert fb.shape == (40, 257)
     assert (fb.sum(axis=1) > 0).all()
+
+
+# -- text datasets (text/datasets parity, local-file parsers) -----------
+def _write_imdb_tar(tmp):
+    import io
+    import tarfile
+
+    path = os.path.join(tmp, "aclImdb.tar.gz")
+    docs = {
+        "train/pos/0.txt": "a great great movie",
+        "train/neg/1.txt": "a terrible movie",
+        "test/pos/0.txt": "great fun",
+        "test/neg/1.txt": "terrible bore",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in docs.items():
+            raw = text.encode()
+            info = tarfile.TarInfo("aclImdb/" + name)
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    return path
+
+
+def test_imdb_dataset(tmp_path):
+    from paddle_tpu.text import Imdb
+
+    path = _write_imdb_tar(str(tmp_path))
+    ds = Imdb(data_file=path, mode="train", cutoff=0, seq_len=6)
+    assert len(ds) == 2
+    ids, label = ds[0]
+    assert ids.shape == (6,) and label in (0, 1)
+    # vocabulary from train split covers its tokens
+    assert "great" in ds.word_idx and "movie" in ds.word_idx
+    test = Imdb(data_file=path, mode="test", cutoff=0)
+    assert len(test) == 2
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="egress"):
+        Imdb(download=True)
+
+
+def test_conll_dataset(tmp_path):
+    from paddle_tpu.text import Conll05st
+
+    p = tmp_path / "conll.txt"
+    p.write_text("The DET\ncat NOUN\nsat VERB\n\nA DET\ndog NOUN\n")
+    ds = Conll05st(data_file=str(p), seq_len=4)
+    assert len(ds) == 2
+    ids, labs = ds[0]
+    assert ids.shape == (4,) and labs.shape == (4,)
+    assert len(ds.label_dict) == 4  # 3 tags + <pad>
+    pad = ds.label_dict["<pad>"]
+    assert (labs[3:] == pad).all()  # padding never aliases a real tag
+
+
+def test_uci_housing(tmp_path):
+    from paddle_tpu.text import UCIHousing
+
+    rows = np.random.RandomState(0).rand(10, 14)
+    p = tmp_path / "housing.data"
+    np.savetxt(p, rows)
+    tr = UCIHousing(data_file=str(p), mode="train")
+    te = UCIHousing(data_file=str(p), mode="test")
+    assert len(tr) == 8 and len(te) == 2
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)

@@ -58,15 +58,24 @@ def _run_repeat_steps(step, x, y, steps):
 
 def _emit(metric, unit, rate, flops_per_unit, on_tpu, extra):
     """Uniform result row: rate in units/s, MFU vs the BASELINE.md 0.45
-    target on the v5e peak (1e12 nominal peak in CPU smoke mode)."""
+    target on the v5e peak (1e12 nominal peak in CPU smoke mode).
+    hbm_gb = currently-allocated device bytes after the run (live-array
+    accounting — the axon tunnel publishes no PJRT allocator stats, see
+    paddle_tpu/device/memory.py)."""
     peak = V5E_PEAK if on_tpu else 1e12
     mfu = rate * flops_per_unit / peak
+    try:
+        from paddle_tpu.device import memory as dmem
+
+        hbm_gb = round(dmem.record_peak() / 1e9, 2)
+    except Exception:
+        hbm_gb = None
     return {
         "metric": metric,
         "value": round(rate, 1),
         "unit": unit,
         "vs_baseline": round(mfu / 0.45, 4),
-    }, f"{extra} mfu={mfu:.3f}"
+    }, f"{extra} mfu={mfu:.3f} hbm_gb={hbm_gb}"
 
 
 def bench_gpt(on_tpu):
@@ -112,15 +121,17 @@ def bench_gpt(on_tpu):
 # reading the numbers below in context:
 # - Large-matmul FLOPs (GPT ffn shapes) sustain ~118 TF/s inside the
 #   full compiled train step (mfu 0.60 on the flagship).
-# - BERT-base-width matmuls (768/3072) sustain the same per-op rate as
-#   GPT-width ones in isolation (~75 TF/s in a scan microbench); the
-#   e2e gap vs GPT (0.36 vs 0.60 mfu) is attention + small-op share at
-#   hidden=768/seq=512, not the matmuls. The flash-vs-dense attention
-#   tradeoff at this shape is measured in ops/pallas/flash_attention.py.
+# - BERT-base e2e was attention-bound in r3 (0.36 mfu): at S512/D64 the
+#   library flash kernel runs 8.9 ms/layer fwd+bwd (768 tiny programs,
+#   twice-recomputing backward). The fused short-seq kernel
+#   (ops/pallas/flash_attention.py shortseq_attention: whole seq in
+#   VMEM, 6 heads per program, single-pass 5-GEMM backward) runs 4.15
+#   ms/layer, lifting the row to 0.53 mfu (r4).
 # - XLA convolutions cap at ~26-43 TF/s at every ResNet-50 shape tried
 #   (3x3 and 1x1, all widths/batches; im2col-as-matmul is slower, NHWC
 #   end-to-end identical — XLA already cancels our NCHW wrappers'
-#   transposes). ResNet's 0.15 mfu is therefore the conv engine's
+#   transposes; the full per-shape sweep is persisted in OPBENCH.json
+#   by bench_ops.py). ResNet's ~0.15 mfu is therefore the conv engine's
 #   practical ceiling here, and ~2350 img/s/chip is in line with
 #   published v5e ResNet-50 throughput; throughput, not mfu-vs-matmul-
 #   peak, is the comparable metric for the conv bench.
@@ -208,15 +219,39 @@ def main():
 
     backend = jax.default_backend()
     on_tpu = backend in ("tpu", "axon")
-    which = os.environ.get("BENCH_MODEL", "gpt")
+    which = os.environ.get("BENCH_MODEL", "all")
     table = {"gpt": bench_gpt, "bert": bench_bert,
              "resnet50": bench_resnet50}
-    fn = table.get(which)
-    if fn is None:
-        sys.exit(f"unknown BENCH_MODEL={which!r}; valid: {sorted(table)}")
-    result, info = fn(on_tpu)
-    print(json.dumps(result))
-    print(f"# backend={backend} {info}", file=sys.stderr)
+    if which == "all":
+        # every BASELINE.md model row, one JSON line each — the GPT
+        # flagship LAST so a last-line parser still reads the headline
+        order = ["bert", "resnet50", "gpt"]
+    elif which in table:
+        order = [which]
+    else:
+        sys.exit(f"unknown BENCH_MODEL={which!r}; valid: "
+                 f"{sorted(table)} or 'all'")
+    flagship_failed = False
+    for name in order:
+        try:
+            result, info = table[name](on_tpu)
+        except Exception as e:  # one broken row must not hide the rest
+            print(f"# {name} FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            # explicit failure row in-position: a last-line parser can
+            # never mistake an earlier model's row for the flagship
+            print(json.dumps({"metric": f"{name}_FAILED", "value": 0,
+                              "unit": "error", "vs_baseline": 0.0}),
+                  flush=True)
+            if name == order[-1]:
+                flagship_failed = True
+            if len(order) == 1:
+                raise
+            continue
+        print(json.dumps(result), flush=True)
+        print(f"# backend={backend} {info}", file=sys.stderr)
+    if flagship_failed:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -138,15 +138,18 @@ def main():
             step_fn = build_step_fn(model, opt, model.loss_fn, step._params,
                                     step._acc_idx)
             accums = step._gather_accums()
+            bufs = step._buf_arrays()
             lr = jnp.asarray(1e-4, jnp.float32)
 
             def body(c):
-                ps, acc, st, x = c
-                loss, nps, nacc = step_fn(ps, acc, lr, st, (x,), x, rng)
-                return (nps, nacc, st + 1, x + (loss * 0).astype(jnp.int32))
+                ps, acc, mb, st, x = c
+                loss, nps, nacc, nmb = step_fn(ps, acc, mb, lr, st, (x,),
+                                               x, rng)
+                return (nps, nacc, nmb, st + 1,
+                        x + (loss * 0).astype(jnp.int32))
 
             st = jnp.asarray(0, jnp.int32)
-            dt = scan_time(body, (params, accums, st, ids))
+            dt = scan_time(body, (params, accums, bufs, st, ids))
             print(f"full step: {dt*1e3:.1f} ms  mfu={ideal/dt:.3f}  "
                   f"(ideal ~{ideal*1e3:.0f} ms)")
 

@@ -29,7 +29,18 @@ def synchronize():
 
 cuda = None  # no CUDA in this build (paddle.device.cuda parity stub)
 
+from paddle_tpu.device.memory import (  # noqa: E402
+    max_memory_allocated,
+    max_memory_reserved,
+    memory_allocated,
+    memory_reserved,
+    memory_stats,
+    reset_peak_memory_stats,
+)
+
 __all__ = [
     "set_device", "get_device", "get_place", "device_count", "Place",
     "is_compiled_with_cuda", "is_compiled_with_tpu", "synchronize",
+    "memory_stats", "memory_allocated", "max_memory_allocated",
+    "memory_reserved", "max_memory_reserved", "reset_peak_memory_stats",
 ]

@@ -1,0 +1,177 @@
+"""Device memory introspection — analog of paddle/fluid/memory/stats.h
+(Stat/StatRegistry, memory_allocated/max_memory_allocated) and
+python/paddle/device/cuda/__init__.py (max_memory_allocated etc.).
+
+Two sources, best first:
+- PJRT per-device memory stats (device.memory_stats(): bytes_in_use,
+  peak_bytes_in_use ...) — real allocator counters on backends that
+  publish them.
+- Live-array accounting: sum of nbytes of jax.live_arrays() on the
+  device, with a process-local high-water mark advanced at every query
+  (and at TrainStep dispatch via record_peak()). The axon TPU tunnel
+  and the CPU backend return no PJRT stats, so this keeps the API
+  functional there; the reference's Stat<T> is likewise a host-side
+  counter, not an allocator hook.
+
+For the true in-program peak (activations + temps inside one XLA
+executable — what HBM pressure actually is on TPU), use
+`program_memory(compiled)` over a compiled/lowered step; bench.py
+prints it per model row.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "memory_stats", "memory_allocated", "max_memory_allocated",
+    "memory_reserved", "max_memory_reserved", "reset_peak_memory_stats",
+    "record_peak", "program_memory",
+]
+
+# process-local high-water marks per device, for backends without PJRT
+# allocator stats ({device_key: peak_bytes})
+_peaks: dict = {}
+
+
+def _device(device=None):
+    import jax
+
+    if device is None:
+        from paddle_tpu.core.device import default_jax_device
+
+        d = default_jax_device()
+        return d if d is not None else jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):
+        from paddle_tpu.core.device import Place
+
+        return Place(device).jax_device()
+    return device
+
+
+def _live_bytes(dev) -> int:
+    import jax
+
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if dev in a.devices():
+                # addressable shard bytes on this device
+                total += sum(s.data.nbytes for s in a.addressable_shards
+                             if s.device == dev)
+        except Exception:
+            continue
+    return total
+
+
+def record_peak(device=None) -> int:
+    """Sample current usage and advance the high-water mark (called by
+    the compiled-step dispatchers; callable any time)."""
+    dev = _device(device)
+    cur = memory_allocated(dev)
+    key = str(dev)
+    if cur > _peaks.get(key, 0):
+        _peaks[key] = cur
+    return cur
+
+
+def memory_stats(device=None) -> dict:
+    """All counters for `device` as a dict (paddle.device.cuda
+    .memory_stats analog). PJRT-backed where available, else live-array
+    accounting (source field says which)."""
+    dev = _device(device)
+    raw: Optional[dict] = None
+    try:
+        raw = dev.memory_stats()
+    except Exception:
+        raw = None
+    if raw:
+        return {
+            "source": "pjrt",
+            "allocated_bytes": raw.get("bytes_in_use", 0),
+            "peak_allocated_bytes": raw.get("peak_bytes_in_use", 0),
+            "reserved_bytes": raw.get("bytes_reserved",
+                                      raw.get("bytes_in_use", 0)),
+            "peak_reserved_bytes": raw.get("peak_bytes_reserved",
+                                           raw.get("peak_bytes_in_use", 0)),
+            "largest_free_block_bytes": raw.get(
+                "largest_free_block_bytes"),
+            "raw": raw,
+        }
+    cur = _live_bytes(dev)
+    key = str(dev)
+    if cur > _peaks.get(key, 0):
+        _peaks[key] = cur
+    return {
+        "source": "live_arrays",
+        "allocated_bytes": cur,
+        "peak_allocated_bytes": _peaks[key],
+        "reserved_bytes": cur,
+        "peak_reserved_bytes": _peaks[key],
+        "largest_free_block_bytes": None,
+        "raw": None,
+    }
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on `device`
+    (paddle.device.cuda.memory_allocated analog)."""
+    dev = _device(device)
+    try:
+        raw = dev.memory_stats()
+        if raw and "bytes_in_use" in raw:
+            return int(raw["bytes_in_use"])
+    except Exception:
+        pass
+    return _live_bytes(dev)
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak allocated bytes since process start / last reset
+    (paddle.device.cuda.max_memory_allocated analog)."""
+    return int(memory_stats(device)["peak_allocated_bytes"])
+
+
+def memory_reserved(device=None) -> int:
+    return int(memory_stats(device)["reserved_bytes"])
+
+
+def max_memory_reserved(device=None) -> int:
+    return int(memory_stats(device)["peak_reserved_bytes"])
+
+
+def reset_peak_memory_stats(device=None) -> None:
+    """Reset the live-array high-water mark (PJRT peaks are allocator-
+    lifetime and cannot be reset from here)."""
+    _peaks[str(_device(device))] = 0
+
+
+def program_memory(compiled) -> dict:
+    """Peak HBM of ONE compiled XLA program: argument/output/temp/gen
+    sizes from compiled.memory_analysis() — temps are the activation
+    working set, the number the reference's memory profiler reports per
+    iteration. Accepts a jax Compiled (from .lower().compile()) or
+    anything exposing memory_analysis()."""
+    out = {"argument_bytes": None, "output_bytes": None,
+           "temp_bytes": None, "generated_code_bytes": None,
+           "total_bytes": None}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return out
+    if ma is None:
+        return out
+    get = lambda n: getattr(ma, n, None)
+    out["argument_bytes"] = get("argument_size_in_bytes")
+    out["output_bytes"] = get("output_size_in_bytes")
+    out["temp_bytes"] = get("temp_size_in_bytes")
+    out["generated_code_bytes"] = get("generated_code_size_in_bytes")
+    alias = get("alias_size_in_bytes") or 0
+    parts = [out["argument_bytes"], out["output_bytes"],
+             out["temp_bytes"], out["generated_code_bytes"]]
+    if all(p is not None for p in parts):
+        # aliased buffers (donated params) are counted in both argument
+        # and output size; subtract one copy
+        out["total_bytes"] = sum(parts) - alias
+    return out

@@ -257,6 +257,31 @@ class Engine:
             logs[name] = m.accumulate()
         return logs
 
+    def _forward_arity(self, available):
+        """How many positional inputs the model's forward REQUIRES
+        (predict's inputs_spec analog). Only no-default positional
+        params count — a defaulted trailing param (e.g. mask=None) is
+        not an input slot, so a labeled batch never feeds its label
+        into it. A *args forward gives no arity signal; fall back to
+        the label-split convention (drop the last field of a >=2-field
+        batch)."""
+        import inspect
+
+        try:
+            sig = inspect.signature(self.model.forward)
+        except (TypeError, ValueError):
+            return max(available - 1, 1) if available >= 2 else available
+        n = 0
+        for p in sig.parameters.values():
+            if p.kind == inspect.Parameter.VAR_POSITIONAL:
+                return max(available - 1, 1) if available >= 2 \
+                    else available
+            if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                          inspect.Parameter.POSITIONAL_OR_KEYWORD) and \
+                    p.default is inspect.Parameter.empty:
+                n += 1
+        return min(n, available)
+
     def predict(self, test_data, batch_size=1, steps=None, verbose=0,
                 num_workers=0):
         self.model.eval()
@@ -266,7 +291,13 @@ class Engine:
         for i, batch in enumerate(loader):
             if steps is not None and i >= steps:
                 break
-            ins, _ = _split_batch(batch)
+            # feed as many batch fields as the model's forward accepts
+            # (reference Engine splits on inputs_spec; the arity of
+            # forward is our spec) — an unlabeled multi-input dataset
+            # keeps its last input, a labeled dataset drops the label
+            ins = tuple(batch) if isinstance(batch, (list, tuple)) \
+                else (batch,)
+            ins = ins[:self._forward_arity(len(ins))]
             out, _ = self._eval_batch(ins, None)
             pred = out[0] if isinstance(out, (list, tuple)) else out
             outs.append(np.asarray(pred))

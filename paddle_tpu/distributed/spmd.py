@@ -109,6 +109,8 @@ class DistributedTrainStep:
         self._params = [p for p in model.parameters()
                         if not p.stop_gradient and id(p) in opt_index]
         self._acc_idx = [opt_index[id(p)] for p in self._params]
+        from paddle_tpu.jit.api import model_buffers
+        self._buffers = model_buffers(model)
         self._jitted = None
         self._donate = donate
         self._placed = False
@@ -132,6 +134,14 @@ class DistributedTrainStep:
                  for p in self._params]
         return specs, [NamedSharding(mesh, s) for s in specs]
 
+    def _buf_shardings(self):
+        """Buffers (BN stats, spectral-norm u/v) follow their dist_spec
+        when a parallel layer set one, else replicate."""
+        mesh = self.hcg.mesh
+        return [NamedSharding(mesh, b.dist_spec)
+                if getattr(b, "dist_spec", None) is not None
+                else NamedSharding(mesh, P()) for b in self._buffers]
+
     def place_params(self):
         """Device-put params (and later opt state) onto the mesh according
         to the plan — the param-broadcast step of distributed_model
@@ -140,6 +150,8 @@ class DistributedTrainStep:
         specs, shardings = self._param_shardings()
         for p, ns in zip(self._params, shardings):
             p._array = jax.device_put(p._array, ns)
+        for b, ns in zip(self._buffers, self._buf_shardings()):
+            b._array = jax.device_put(b._array, ns)
         opt = self.optimizer
         opt._ensure_state()
         rest = self._acc_host_shardings() if self.offload \
@@ -188,7 +200,8 @@ class DistributedTrainStep:
         acc_shardings = {k: dev for k in accum_names}
         repl = NamedSharding(mesh, P())
 
-        step_fn = build_step_fn(model, opt, loss_fn, params, self._acc_idx)
+        step_fn = build_step_fn(model, opt, loss_fn, params, self._acc_idx,
+                                buffers=self._buffers)
 
         # input shardings are taken from the committed arrays (params/accums
         # are device_put by place_params, the batch by __call__); pinning
@@ -197,8 +210,9 @@ class DistributedTrainStep:
             repl,
             param_shardings,
             {k: acc_shardings[k] for k in accum_names},
+            self._buf_shardings(),
         )
-        donate = (0, 1) if self._donate else ()
+        donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step_fn, donate_argnums=donate,
                        out_shardings=out_shardings)
 
@@ -239,7 +253,9 @@ class DistributedTrainStep:
             # same (typed) key flavor as next_key() so the lowered
             # signature matches the executed one (no duplicate compile)
             key = jax.random.key(0)
-        return (param_arrays, accums, lr, stepc, in_arrays, label_arr, key)
+        bufs = [b._array for b in self._buffers]
+        return (param_arrays, accums, bufs, lr, stepc, in_arrays,
+                label_arr, key)
 
     @staticmethod
     def _split_label(inputs, label):
@@ -278,9 +294,10 @@ class DistributedTrainStep:
         accum_names = list(self.optimizer._accumulators.keys())
         acc_sh = {k: buf_sh for k in accum_names}
 
-        donate = (0,) if self._donate else ()
+        donate = (0, 2) if self._donate else ()
         acc_jit = jax.jit(acc_fn, donate_argnums=donate,
-                          out_shardings=(repl, buf_sh))
+                          out_shardings=(repl, buf_sh,
+                                         self._buf_shardings()))
         upd_jit = jax.jit(
             upd_fn,
             donate_argnums=(0, 1, 2) if self._donate else (),
@@ -310,9 +327,12 @@ class DistributedTrainStep:
                 jax.device_put(jnp.zeros(p._array.shape, jnp.float32),
                                sh[i])
                 for i, p in enumerate(self._params)]
-        loss, self._grad_bufs = self._acc_jitted(
+        loss, self._grad_bufs, new_model_bufs = self._acc_jitted(
             self._grad_bufs, [p._array for p in self._params],
+            [b._array for b in self._buffers],
             in_arrays, label_arr, random_mod.next_key())
+        for b, a in zip(self._buffers, new_model_bufs):
+            b._array = a
         self._accum_count += 1
         if self._accum_count >= self.accumulate_steps:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -336,9 +356,11 @@ class DistributedTrainStep:
         from paddle_tpu.jit.api import scatter_accums
 
         opt = self.optimizer
-        loss, new_params, new_accums = self._jitted(*args)
+        loss, new_params, new_accums, new_bufs = self._jitted(*args)
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
+        for b, a in zip(self._buffers, new_bufs):
+            b._array = a
         if self.offload:
             host = self._acc_host_shardings()
             new_accums = {

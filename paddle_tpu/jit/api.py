@@ -32,6 +32,17 @@ from paddle_tpu.core import autograd
 from paddle_tpu.core.tensor import Tensor
 
 
+_bound_depth = 0
+
+
+def buffer_writes_captured():
+    """True while a bound_state scope is live — i.e. in-trace buffer
+    assignments will be captured by the compiled step's buffer plumbing
+    (make_forward_loss) and then restored; layers that guard against
+    tracer leaks (SpectralNorm) may write tracers freely here."""
+    return _bound_depth > 0
+
+
 @contextmanager
 def bound_state(bind_pairs, restore_tensors):
     """Bind traced arrays into live Tensor objects for the duration of a
@@ -39,12 +50,15 @@ def bound_state(bind_pairs, restore_tensors):
     (e.g. BN running stats) can't leak tracers into the eager world. The
     one bind/restore dance shared by compiled train steps and the hapi
     eval path."""
+    global _bound_depth
     originals = [t._array for t in restore_tensors]
     try:
         for t, a in bind_pairs:
             t._array = a
+        _bound_depth += 1
         yield
     finally:
+        _bound_depth -= 1
         for t, o in zip(restore_tensors, originals):
             t._array = o
 
@@ -339,30 +353,46 @@ def not_to_static(fn):
     return fn
 
 
-def make_forward_loss(model, loss_fn, params, with_outputs=False):
-    """The traced forward: bind param arrays into the live Parameters,
-    run the eager forward under the per-step rng, return the loss array
-    (optionally with the model outputs as aux). Shared by build_step_fn
-    and TrainStep's gradient-accumulation programs."""
+def model_buffers(model):
+    """The ordered buffer list threaded through compiled steps (must be
+    identical between make_forward_loss and the caller's writeback)."""
+    return list(model.buffers()) if hasattr(model, "buffers") else []
+
+
+def make_forward_loss(model, loss_fn, params, with_outputs=False,
+                      buffers=None):
+    """The traced forward: bind param AND buffer arrays into the live
+    Tensors, run the eager forward under the per-step rng, return
+    (loss, (new_buffers, outputs-or-None)). Buffer mutations made by the
+    forward (BN running stats, SpectralNorm power-iteration u/v) are
+    captured before bound_state restores the eager arrays, so compiled
+    steps persist them — the analog of the reference's in-place
+    MomentumTensor updates inside run_program. Shared by build_step_fn
+    and the gradient-accumulation programs."""
     from paddle_tpu.core import random as random_mod
 
-    buffers = list(model.buffers()) if hasattr(model, "buffers") else []
+    if buffers is None:
+        buffers = model_buffers(model)
 
-    def forward_loss(param_arrays, inputs, label, rng):
+    def forward_loss(param_arrays, buf_arrays, inputs, label, rng):
         # rng is the per-step traced key that dropout & friends derive
-        # from (random.key_scope); buffer updates are dropped inside
-        # compiled steps (bound_state restores them).
-        with bound_state(zip(params, param_arrays), params + buffers):
+        # from (random.key_scope)
+        with bound_state(zip(params + buffers,
+                             list(param_arrays) + list(buf_arrays)),
+                         params + buffers):
             with random_mod.key_scope(rng):
                 out = model(*inputs) if isinstance(inputs, tuple) else model(inputs)
                 loss = loss_fn(out, Tensor._wrap(label)) if loss_fn is not None else out
             loss_arr = loss._array if isinstance(loss, Tensor) else loss
+            # capture in-trace buffer writes BEFORE bound_state restores;
+            # stop_gradient — buffer state is never a differentiable path
+            new_bufs = [jax.lax.stop_gradient(b._array) for b in buffers]
+            out_arrs = None
             if with_outputs:
                 out_arrs = jax.tree_util.tree_map(
                     lambda t: t._array if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
-                return loss_arr, out_arrs
-            return loss_arr
+            return loss_arr, (new_bufs, out_arrs)
 
     return forward_loss
 
@@ -377,6 +407,12 @@ def make_update_fn(opt, acc_idx, params):
     accum_names = list(opt._accumulators.keys())
     grad_clip = opt._grad_clip
     extras_list = [opt._per_param_extras(j) for j in acc_idx]
+    # ASP n:m sparsity masks (incubate.asp.prune_model sets _asp_mask):
+    # re-applied after every compiled update so sparsity holds on the
+    # TrainStep paths too, not just eager optimizer.step (the reference's
+    # OptimizerWithSparsityGuarantee runs inside minimize). Masks are
+    # constants baked at trace time — prune before the first step.
+    asp_masks = [getattr(p, "_asp_mask", None) for p in params]
 
     def update(param_arrays, grads, accums, lr, step, skip=None):
         if grad_clip is not None:
@@ -389,6 +425,8 @@ def make_update_fn(opt, acc_idx, params):
             acc_i = {k: accums[k][i] for k in accum_names}
             np_, na = single_update(p, g, acc_i, lr, step,
                                     extras=extras_list[i])
+            if asp_masks[i] is not None:
+                np_ = np_ * jnp.asarray(asp_masks[i], np_.dtype)
             if skip is not None:
                 # skip the whole update on overflow (GradScaler.step
                 # semantics): params and opt state keep their old values
@@ -404,31 +442,34 @@ def make_update_fn(opt, acc_idx, params):
 
 
 def build_step_fn(model, opt, loss_fn, params, acc_idx,
-                  with_outputs=False, with_scaler=False):
+                  with_outputs=False, with_scaler=False, buffers=None):
     """The ONE compiled-train-step body shared by jit.TrainStep (single
     device) and distributed.DistributedTrainStep (SPMD — which adds
     shardings around it): value_and_grad over the model's eager forward
     with params bound as traced args, grad clip, then the optimizer's
     per-param update. Signature of the returned fn:
-    (param_arrays, accums, lr, step, inputs, label, rng) ->
-    (loss, new_params, new_accums) — or with_outputs=True:
-    ((loss, out), new_params, new_accums), the hapi train-metrics path
-    (outputs ride along as value_and_grad aux, no second forward)."""
-    forward_loss = make_forward_loss(model, loss_fn, params, with_outputs)
+    (param_arrays, accums, bufs, lr, step, inputs, label, rng) ->
+    (loss, new_params, new_accums, new_bufs) — or with_outputs=True:
+    ((loss, out), ...), the hapi train-metrics path (outputs ride along
+    as value_and_grad aux, no second forward). `bufs` are the model's
+    non-trainable buffers (BN running stats, spectral-norm u/v) whose
+    in-forward updates persist across compiled steps."""
+    if buffers is None:
+        buffers = model_buffers(model)
+    forward_loss = make_forward_loss(model, loss_fn, params, with_outputs,
+                                     buffers=buffers)
     update = make_update_fn(opt, acc_idx, params)
 
-    def step_fn(param_arrays, accums, lr, step, inputs, label, rng,
+    def step_fn(param_arrays, accums, bufs, lr, step, inputs, label, rng,
                 scale=None):
         if with_scaler:
             # the UNSCALED loss rides along as aux, so the reported loss
             # stays exact even when the scaled one overflows
             def scaled_loss(pa, ins, lb, r):
-                out = forward_loss(pa, ins, lb, r)
-                if with_outputs:
-                    return out[0] * scale, out
-                return out * scale, out
-            (_, loss), grads = jax.value_and_grad(scaled_loss,
-                                                  has_aux=True)(
+                loss, aux = forward_loss(pa, bufs, ins, lb, r)
+                return loss * scale, (loss, aux)
+            (_, (loss, (new_bufs, out))), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(
                 param_arrays, inputs, label, rng)
             found_inf = jnp.logical_not(jnp.stack(
                 [jnp.all(jnp.isfinite(g)) for g in grads]).all())
@@ -436,32 +477,36 @@ def build_step_fn(model, opt, loss_fn, params, acc_idx,
             # reciprocal is subnormal and XLA flushes it to zero
             grads = [(g.astype(jnp.float32) / scale).astype(p.dtype)
                      for g, p in zip(grads, param_arrays)]
+            # a skipped step must not advance buffer state either
+            new_bufs = [jnp.where(found_inf, b, nb)
+                        for b, nb in zip(bufs, new_bufs)]
         else:
-            loss, grads = jax.value_and_grad(forward_loss,
-                                             has_aux=with_outputs)(
-                param_arrays, inputs, label, rng)
+            (loss, (new_bufs, out)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(
+                param_arrays, bufs, inputs, label, rng)
         from paddle_tpu.framework import nan_inf
 
         if nan_inf.check_enabled():
             # FLAGS_check_nan_inf inside the compiled step: loss + every
             # grad, named, via one staged host callback (SURVEY §7)
-            loss_arr = loss[0] if with_outputs else loss
-            named = [("loss", loss_arr)] + [
+            named = [("loss", loss)] + [
                 (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
                 for i, (p, g) in enumerate(zip(params, grads))]
             nan_inf.stage_check(named, "compiled train step")
         new_params, new_accums = update(
             param_arrays, grads, accums, lr, step,
             skip=found_inf if with_scaler else None)
+        if with_outputs:
+            loss = (loss, out)
         if with_scaler:
-            return loss, found_inf, new_params, new_accums
-        return loss, new_params, new_accums
+            return loss, found_inf, new_params, new_accums, new_bufs
+        return loss, new_params, new_accums, new_bufs
 
     return step_fn
 
 
 def make_accum_fns(model, optimizer, loss_fn, params, acc_idx, K,
-                   avg=True):
+                   avg=True, with_scaler=False):
     """Gradient-merge closure pair shared by TrainStep and
     DistributedTrainStep: accumulate (forward+backward into f32
     buffers, no update; FLAGS_check_nan_inf staged per micro-step) and
@@ -469,22 +514,76 @@ def make_accum_fns(model, optimizer, loss_fn, params, acc_idx, K,
     GradientMergeOptimizer parity — buffers zeroed). Built from the
     same make_forward_loss/make_update_fn pieces as the normal step so
     clip/nan-check behavior can't drift; callers add their own jit
-    options/shardings."""
+    options/shardings.
+
+    with_scaler=True (GradScaler x gradient accumulation, the
+    reference's gradient_merge + amp composition): acc_fn gains
+    (found, ..., scale) and accumulates SCALED f32 grads while OR-ing
+    per-micro-step non-finiteness into `found`; upd_fn divides by
+    scale*K and skips the whole window's update on overflow, exactly
+    like the unaccumulated GradScaler.step path."""
     from paddle_tpu.framework import nan_inf
 
-    forward_loss = make_forward_loss(model, loss_fn, params)
+    buffers = model_buffers(model)
+    forward_loss = make_forward_loss(model, loss_fn, params,
+                                     buffers=buffers)
     update = make_update_fn(optimizer, acc_idx, params)
 
-    def acc_fn(bufs, param_arrays, inputs, label, rng):
-        loss, grads = jax.value_and_grad(forward_loss)(
-            param_arrays, inputs, label, rng)
+    def _grads_and_bufs(param_arrays, model_bufs, inputs, label, rng,
+                        scale):
+        if with_scaler:
+            def scaled_loss(pa, ins, lb, r):
+                loss, aux = forward_loss(pa, model_bufs, ins, lb, r)
+                return loss * scale, (loss, aux)
+            (_, (loss, (new_model_bufs, _))), grads = jax.value_and_grad(
+                scaled_loss, has_aux=True)(param_arrays, inputs, label,
+                                           rng)
+        else:
+            (loss, (new_model_bufs, _)), grads = jax.value_and_grad(
+                forward_loss, has_aux=True)(
+                param_arrays, model_bufs, inputs, label, rng)
         if nan_inf.check_enabled():
             named = [("loss", loss)] + [
                 (f"{getattr(p, 'name', None) or f'param{i}'}.grad", g)
                 for i, (p, g) in enumerate(zip(params, grads))]
             nan_inf.stage_check(named, "gradient-merge micro-step")
+        return loss, grads, new_model_bufs
+
+    if with_scaler:
+        def acc_fn(bufs, found, param_arrays, model_bufs, inputs, label,
+                   rng, scale):
+            loss, grads, new_model_bufs = _grads_and_bufs(
+                param_arrays, model_bufs, inputs, label, rng, scale)
+            micro_inf = jnp.logical_not(jnp.stack(
+                [jnp.all(jnp.isfinite(g)) for g in grads]).all())
+            # an overflowed micro-step must not advance buffer state
+            # (matches the unaccumulated scaler step)
+            new_model_bufs = [jnp.where(micro_inf, b, nb)
+                              for b, nb in zip(model_bufs,
+                                               new_model_bufs)]
+            return (loss, [b + g.astype(jnp.float32)
+                           for b, g in zip(bufs, grads)],
+                    jnp.logical_or(found, micro_inf), new_model_bufs)
+
+        def upd_fn(param_arrays, accums, bufs, lr, step, scale, found):
+            div = (K if avg else 1)
+            # divide by the (large) scale BEFORE the micro-count: the
+            # scaled f32 sum stays far from overflow, and dividing by
+            # scale avoids the subnormal-reciprocal trap
+            grads = [(b / scale / div).astype(p.dtype)
+                     for b, p in zip(bufs, param_arrays)]
+            new_params, new_accums = update(param_arrays, grads, accums,
+                                            lr, step, skip=found)
+            zeroed = [jnp.zeros_like(b) for b in bufs]
+            return new_params, new_accums, zeroed
+
+        return acc_fn, upd_fn
+
+    def acc_fn(bufs, param_arrays, model_bufs, inputs, label, rng):
+        loss, grads, new_model_bufs = _grads_and_bufs(
+            param_arrays, model_bufs, inputs, label, rng, None)
         return loss, [b + g.astype(jnp.float32)
-                      for b, g in zip(bufs, grads)]
+                      for b, g in zip(bufs, grads)], new_model_bufs
 
     def upd_fn(param_arrays, accums, bufs, lr, step):
         div = K if avg else 1
@@ -540,9 +639,6 @@ class TrainStep:
         # fp16 loss scaling (GradScaler) INSIDE the compiled step: scale
         # loss, unscale grads, skip the update when any grad is non-finite
         self.scaler = scaler
-        if scaler is not None and self.accumulate_steps > 1:
-            raise NotImplementedError(
-                "accumulate_steps with a GradScaler is not supported yet")
         if with_outputs and self.accumulate_steps > 1:
             raise NotImplementedError(
                 "accumulate_steps with with_outputs is not supported")
@@ -556,6 +652,9 @@ class TrainStep:
         self._params = [p for p in model.parameters()
                         if not p.stop_gradient and id(p) in opt_index]
         self._acc_idx = [opt_index[id(p)] for p in self._params]
+        # buffers thread through the compiled step so in-forward updates
+        # (BN running stats, spectral-norm u/v) persist across steps
+        self._buffers = model_buffers(model)
         self._jitted = None
         self._scan_jitted = None
         self._donate = donate
@@ -563,7 +662,14 @@ class TrainStep:
 
     def _build(self):
         return jax.jit(self._make_step_fn(),
-                       donate_argnums=(0, 1) if self._donate else ())
+                       donate_argnums=(0, 1, 2) if self._donate else ())
+
+    def _buf_arrays(self):
+        return [b._array for b in self._buffers]
+
+    def _write_buffers(self, new_bufs):
+        for b, a in zip(self._buffers, new_bufs):
+            b._array = a
 
     def _gather_accums(self):
         return gather_accums(self.optimizer, self._acc_idx)
@@ -595,7 +701,8 @@ class TrainStep:
         return build_step_fn(self.model, self.optimizer, self.loss_fn,
                              self._params, self._acc_idx,
                              with_outputs=self.with_outputs,
-                             with_scaler=self._with_scaler())
+                             with_scaler=self._with_scaler(),
+                             buffers=self._buffers)
 
     def run_scan(self, inputs_stacked, labels_stacked):
         """Run a whole sequence of steps inside ONE XLA program via
@@ -613,8 +720,8 @@ class TrainStep:
         xs = _unwrap(inputs_stacked)
         ys = _unwrap(labels_stacked)
         return self._dispatch_steps(
-            lambda pa, acc, lr, st, rng: self._scan_jitted(
-                pa, acc, lr, st, xs, ys, rng),
+            lambda pa, acc, bufs, lr, st, rng: self._scan_jitted(
+                pa, acc, bufs, lr, st, xs, ys, rng),
             int(xs.shape[0]))
 
     def run_repeat(self, inputs, labels, steps):
@@ -634,26 +741,27 @@ class TrainStep:
             self.optimizer._ensure_state()
             base_step = self._make_step_fn()
 
-            def repeat_all(param_arrays, accums, lr, step0, x, y, n, rng):
+            def repeat_all(param_arrays, accums, bufs, lr, step0, x, y, n,
+                           rng):
                 def body(carry, i):
-                    params, accs, st = carry
-                    loss, nparams, naccs = base_step(
-                        params, accs, lr, st, (x,), y,
+                    params, accs, mb, st = carry
+                    loss, nparams, naccs, nmb = base_step(
+                        params, accs, mb, lr, st, (x,), y,
                         jax.random.fold_in(rng, st))
-                    return (nparams, naccs, st + 1), loss
+                    return (nparams, naccs, nmb, st + 1), loss
 
-                (fp, fa, _), losses = jax.lax.scan(
-                    body, (param_arrays, accums, step0),
+                (fp, fa, fb, _), losses = jax.lax.scan(
+                    body, (param_arrays, accums, bufs, step0),
                     jnp.arange(n, dtype=jnp.int32))
-                return losses, fp, fa
+                return losses, fp, fa, fb
 
             self._repeat_jitted = jax.jit(
                 repeat_all, static_argnames="n",
-                donate_argnums=(0, 1) if self._donate else ())
+                donate_argnums=(0, 1, 2) if self._donate else ())
             self._repeat_key = key
         losses = self._dispatch_steps(
-            lambda pa, acc, lr, st, rng: self._repeat_jitted(
-                pa, acc, lr, st, xs, ys, steps, rng),
+            lambda pa, acc, bufs, lr, st, rng: self._repeat_jitted(
+                pa, acc, bufs, lr, st, xs, ys, steps, rng),
             steps)
         return losses
 
@@ -662,7 +770,8 @@ class TrainStep:
         make_accum_fns so the mesh edition can't drift)."""
         acc_fn, upd_fn = make_accum_fns(
             self.model, self.optimizer, self.loss_fn, self._params,
-            self._acc_idx, self.accumulate_steps)
+            self._acc_idx, self.accumulate_steps,
+            with_scaler=self._with_scaler())
         donate = (0,) if self._donate else ()
         return (jax.jit(acc_fn, donate_argnums=donate),
                 jax.jit(upd_fn, donate_argnums=(0, 1, 2)
@@ -672,27 +781,59 @@ class TrainStep:
         from paddle_tpu.framework.flags import debug_epoch
 
         opt = self.optimizer
+        key = (debug_epoch(), self._with_scaler())
         if getattr(self, "_acc_jitted", None) is None or \
-                getattr(self, "_acc_epoch", None) != debug_epoch():
+                getattr(self, "_acc_epoch", None) != key:
             self._acc_jitted, self._upd_jitted = self._build_accum_fns()
-            self._acc_epoch = debug_epoch()
+            self._acc_epoch = key
         if self._grad_bufs is None:
             self._grad_bufs = [jnp.zeros(p._array.shape, jnp.float32)
                                for p in self._params]
-        loss, self._grad_bufs = self._acc_jitted(
-            self._grad_bufs, [p._array for p in self._params],
-            in_arrays, label_arr, self._next_step_key())
+        with_scaler = self._with_scaler()
+        if with_scaler:
+            scale = jnp.float32(self.scaler.get_scale())
+            found = getattr(self, "_accum_found", None)
+            if found is None:
+                found = jnp.bool_(False)
+            loss, self._grad_bufs, found, new_model_bufs = \
+                self._acc_jitted(
+                    self._grad_bufs, found,
+                    [p._array for p in self._params],
+                    self._buf_arrays(), in_arrays, label_arr,
+                    self._next_step_key(), scale)
+            self._accum_found = found
+        else:
+            loss, self._grad_bufs, new_model_bufs = self._acc_jitted(
+                self._grad_bufs, [p._array for p in self._params],
+                self._buf_arrays(), in_arrays, label_arr,
+                self._next_step_key())
+        self._write_buffers(new_model_bufs)
         self._accum_count += 1
         if self._accum_count >= self.accumulate_steps:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             stepc = jnp.asarray(opt._step_count, jnp.int32)
-            new_params, new_accums, self._grad_bufs = self._upd_jitted(
-                [p._array for p in self._params],
-                self._gather_accums(), self._grad_bufs, lr, stepc)
+            if with_scaler:
+                new_params, new_accums, self._grad_bufs = \
+                    self._upd_jitted(
+                        [p._array for p in self._params],
+                        self._gather_accums(), self._grad_bufs, lr,
+                        stepc, scale, self._accum_found)
+                skipped = bool(self._accum_found)
+                self.scaler._found_inf = skipped
+                self.scaler.update()
+                self._accum_found = jnp.bool_(False)
+            else:
+                new_params, new_accums, self._grad_bufs = \
+                    self._upd_jitted(
+                        [p._array for p in self._params],
+                        self._gather_accums(), self._grad_bufs, lr,
+                        stepc)
+                skipped = False
             for p, a in zip(self._params, new_params):
                 p._in_place_update(a)
             self._scatter_accums(new_accums)
-            opt._step_count += 1
+            if not skipped:
+                opt._step_count += 1
             self._accum_count = 0
         return Tensor._wrap(loss)
 
@@ -705,11 +846,13 @@ class TrainStep:
         accums = self._gather_accums()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
-        losses, new_params, new_accums = call(
-            param_arrays, accums, lr, stepc, self._next_step_key())
+        losses, new_params, new_accums, new_bufs = call(
+            param_arrays, accums, self._buf_arrays(), lr, stepc,
+            self._next_step_key())
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
         self._scatter_accums(new_accums)
+        self._write_buffers(new_bufs)
         opt._step_count += nsteps
         return Tensor._wrap(losses)
 
@@ -719,20 +862,20 @@ class TrainStep:
         self._check_plain("run_scan")
         base_step = self._make_step_fn()
 
-        def scan_all(param_arrays, accums, lr, step0, xs, ys, rng):
+        def scan_all(param_arrays, accums, bufs, lr, step0, xs, ys, rng):
             def body(carry, xy):
-                params, accs, st = carry
+                params, accs, mb, st = carry
                 x, y = xy
-                loss, nparams, naccs = base_step(
-                    params, accs, lr, st, (x,), y,
+                loss, nparams, naccs, nmb = base_step(
+                    params, accs, mb, lr, st, (x,), y,
                     jax.random.fold_in(rng, st))
-                return (nparams, naccs, st + 1), loss
+                return (nparams, naccs, nmb, st + 1), loss
 
-            (fparams, faccums, _), losses = jax.lax.scan(
-                body, (param_arrays, accums, step0), (xs, ys))
-            return losses, fparams, faccums
+            (fparams, faccums, fbufs, _), losses = jax.lax.scan(
+                body, (param_arrays, accums, bufs, step0), (xs, ys))
+            return losses, fparams, faccums, fbufs
 
-        donate = (0, 1) if self._donate else ()
+        donate = (0, 1, 2) if self._donate else ()
         return jax.jit(scan_all, donate_argnums=donate)
 
     def __call__(self, *inputs, label=None):
@@ -755,24 +898,27 @@ class TrainStep:
             return self._call_accumulate(in_arrays, label_arr)
         param_arrays = [p._array for p in self._params]
         accums = self._gather_accums()
+        bufs = self._buf_arrays()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         stepc = jnp.asarray(opt._step_count, jnp.int32)
         if self._with_scaler():
-            loss, found_inf, new_params, new_accums = self._jitted(
-                param_arrays, accums, lr, stepc, in_arrays, label_arr,
-                self._next_step_key(),
-                jnp.float32(self.scaler.get_scale()))
+            loss, found_inf, new_params, new_accums, new_bufs = \
+                self._jitted(
+                    param_arrays, accums, bufs, lr, stepc, in_arrays,
+                    label_arr, self._next_step_key(),
+                    jnp.float32(self.scaler.get_scale()))
             skipped = bool(found_inf)
             self.scaler._found_inf = skipped
             self.scaler.update()
         else:
-            loss, new_params, new_accums = self._jitted(
-                param_arrays, accums, lr, stepc, in_arrays, label_arr,
-                self._next_step_key())
+            loss, new_params, new_accums, new_bufs = self._jitted(
+                param_arrays, accums, bufs, lr, stepc, in_arrays,
+                label_arr, self._next_step_key())
             skipped = False
         for p, a in zip(self._params, new_params):
             p._in_place_update(a)
         self._scatter_accums(new_accums)
+        self._write_buffers(new_bufs)
         if not skipped:
             # a scaler-skipped step doesn't count (GradScaler.step skips
             # optimizer.step entirely — bias-correction t must match the

@@ -207,12 +207,18 @@ class SpectralNorm(Layer):
 
         out, u2, v2 = apply("spectral_norm", fn, as_tensor(weight),
                             self.weight_u, self.weight_v)
-        # persist the advanced power-iteration state (buffers); under a
-        # jit trace the arrays are tracers — state then rides the
-        # compiled step's buffer plumbing instead. Only train mode
-        # advances the stored state (eval iterates from it but leaves
-        # it untouched, so eval is idempotent).
-        if self.training and not isinstance(u2._array, jax.core.Tracer):
+        # persist the advanced power-iteration state. Inside a compiled
+        # train step (bound_state scope) the arrays are tracers, but
+        # make_forward_loss captures buffer writes and threads them
+        # through the step's outputs, so writing is both safe and
+        # required for sigma to converge across steps. Outside any
+        # bound_state scope a tracer write would leak into the eager
+        # world (e.g. a bare jax.jit over forward) — skip it there.
+        # Only train mode advances the stored state (eval iterates from
+        # it but leaves it untouched, so eval is idempotent).
+        from paddle_tpu.jit.api import buffer_writes_captured
+        if self.training and (buffer_writes_captured()
+                              or not isinstance(u2._array, jax.core.Tracer)):
             self.weight_u._array = u2._array
             self.weight_v._array = v2._array
         return out

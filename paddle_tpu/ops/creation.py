@@ -161,10 +161,14 @@ def complex(real, imag, name=None):
 
     r = as_tensor(real)
     i = as_tensor(imag, r)
+    # float width follows the inputs (float64 → complex128 where x64 is
+    # enabled), not a hard-coded float32
+    fdt = jnp.promote_types(r._array.dtype, i._array.dtype)
+    if not jnp.issubdtype(fdt, jnp.floating):
+        fdt = jnp.dtype(jnp.float32)
 
     def fn(a, b):
-        a, b = jnp.broadcast_arrays(a.astype(jnp.float32),
-                                    b.astype(jnp.float32))
+        a, b = jnp.broadcast_arrays(a.astype(fdt), b.astype(fdt))
         return jax.lax.complex(a, b)
 
     from paddle_tpu.core.device import supports_complex
@@ -173,10 +177,14 @@ def complex(real, imag, name=None):
             not isinstance(r._array, jax.core.Tracer):
         from .dispatch import apply_with_cpu_fallback
 
-        # two-input op: hop both (broadcast) inputs via one packed call
-        ra, ia = jnp.broadcast_arrays(r._array.astype(jnp.float32),
-                                      i._array.astype(jnp.float32))
-        packed = Tensor._wrap(jnp.stack([ra, ia]))
+        # two-input op: pack both (broadcast) inputs ON the tape — the
+        # pack is itself an apply() so gradients flow to r AND i through
+        # the fallback path — then hop the packed array to CPU
+        packed = apply(
+            "complex_pack",
+            lambda a, b: jnp.stack(
+                jnp.broadcast_arrays(a.astype(fdt), b.astype(fdt))),
+            r, i)
         return apply_with_cpu_fallback(
             apply, "complex", lambda p: jax.lax.complex(p[0], p[1]),
             packed, supports_complex, complex_stays_on_cpu=True)

@@ -1153,10 +1153,14 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     dl = _pair(dilations)
 
     def fn(a):
+        # precision=HIGHEST: the patch extraction is pure data movement
+        # (one-hot kernel) — default bf16 MXU precision would quantize
+        # the activations, whereas the reference's im2col is exact
         p = jax.lax.conv_general_dilated_patches(
             a, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])],
             rhs_dilation=dl,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            precision=jax.lax.Precision.HIGHEST)
         # p: [B, C*kh*kw, Ho, Wo] with channel-major blocks already
         B, CK, Ho, Wo = p.shape
         return p.reshape(B, CK, Ho * Wo)
@@ -1182,10 +1186,13 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
         C = a.shape[1] // (ks[0] * ks[1])
 
         def extract(img):
+            # HIGHEST precision for the same exactness reason as unfold
+            # (the vjp of an exact gather is an exact scatter-add)
             p = jax.lax.conv_general_dilated_patches(
                 img, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])],
                 rhs_dilation=dl,
-                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                precision=jax.lax.Precision.HIGHEST)
             return p.reshape(B, p.shape[1], -1)
 
         zeros = jnp.zeros((B, C, oh, ow), a.dtype)

@@ -154,3 +154,52 @@ def test_own_pallas_kernel_interpret_mode():
                                  block_q=128, block_k=128, interpret=True)
     ref = _dense_ref(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
+
+
+def test_shortseq_attention_interpret_fwd_and_grad():
+    """The fused encoder kernel (whole-seq per program, single-pass bwd)
+    must match dense attention in value AND gradient — interpret mode
+    exercises the exact kernel code on CPU."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    rng = np.random.RandomState(0)
+    B, S, H, D = 2, 256, 3, 64  # BH=6 exercises hb=6 head batching
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    out = fa.shortseq_attention(q, k, v, interpret=True)
+    ref = _dense_ref(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(fa.shortseq_attention(q, k, v, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+        p = jax.nn.softmax(logits, -1)
+        o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+        return jnp.sum(o ** 2)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_shortseq_hb_divisor():
+    from paddle_tpu.ops.pallas.flash_attention import _shortseq_hb
+
+    assert _shortseq_hb(768) == 6
+    assert _shortseq_hb(8) == 4
+    assert _shortseq_hb(7) == 1
+    for bh in (2, 3, 4, 6, 12, 768):
+        assert bh % _shortseq_hb(bh) == 0

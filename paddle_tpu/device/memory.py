@@ -8,10 +8,15 @@ Two sources, best first:
   publish them.
 - Live-array accounting: sum of nbytes of jax.live_arrays() on the
   device, with a process-local high-water mark advanced at every query
-  (and at TrainStep dispatch via record_peak()). The axon TPU tunnel
-  and the CPU backend return no PJRT stats, so this keeps the API
-  functional there; the reference's Stat<T> is likewise a host-side
-  counter, not an allocator hook.
+  (memory_stats/max_memory_allocated/record_peak — NOT automatically
+  during training steps: a per-step live_arrays() walk in the hot path
+  would cost more than it tells; call record_peak() at the points you
+  care about, as bench.py does after each timed run). The axon TPU
+  tunnel and the CPU backend return no PJRT stats, so this keeps the
+  API functional there; the reference's Stat<T> is likewise a
+  host-side counter, not an allocator hook. Note the live-array view
+  counts HBM-resident arrays only — in-program activation temps are
+  visible through program_memory() instead.
 
 For the true in-program peak (activations + temps inside one XLA
 executable — what HBM pressure actually is on TPU), use

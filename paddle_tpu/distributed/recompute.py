@@ -26,12 +26,19 @@ def recompute(function, *args, use_reentrant=True, **kwargs):
     import jax.numpy as jnp
 
     layer_params = list(function.parameters()) if hasattr(function, "parameters") else []
+    layer_buffers = list(function.buffers()) if hasattr(function, "buffers") else []
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     diff_inputs = tensor_args + [p for p in layer_params if not p.stop_gradient]
 
     def pure(*arrays):
+        """Returns (outputs, new_buffer_arrays): buffer mutations made
+        by the segment (BN running stats) ride along as vjp aux — they
+        are computed in the UNREMATTED forward, restored here so no
+        tracer leaks, and written back by the caller below (the same
+        capture contract as jit.api.make_forward_loss)."""
         n_args = len(tensor_args)
         originals = [p._array for p in diff_inputs[n_args:]]
+        buf_originals = [b._array for b in layer_buffers]
         it = iter(arrays[:n_args])
         new_args = [
             Tensor._wrap(next(it), stop_gradient=a.stop_gradient)
@@ -42,26 +49,44 @@ def recompute(function, *args, use_reentrant=True, **kwargs):
             for p, arr in zip(diff_inputs[n_args:], arrays[n_args:]):
                 p._array = arr
             out = function(*new_args, **kwargs)
-            return jax.tree_util.tree_map(
+            tree_out = jax.tree_util.tree_map(
                 lambda t: t._array if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
+            new_bufs = [jax.lax.stop_gradient(b._array)
+                        for b in layer_buffers]
+            return tree_out, new_bufs
         finally:
             for p, o in zip(diff_inputs[n_args:], originals):
                 p._array = o
+            for b, o in zip(layer_buffers, buf_originals):
+                b._array = o
+
+    def write_bufs(new_bufs):
+        # tracer writes are safe only where something downstream
+        # captures+restores them (a bound_state scope); else they would
+        # leak into the eager world — same guard as SpectralNorm
+        from paddle_tpu.jit.api import buffer_writes_captured
+
+        for b, a in zip(layer_buffers, new_bufs):
+            if buffer_writes_captured() or \
+                    not isinstance(a, jax.core.Tracer):
+                b._array = a
 
     arrays = [t._array for t in diff_inputs]
     needs_grad = is_grad_enabled() and any(
         not t.stop_gradient for t in diff_inputs)
     OpStats.record("recompute")
     if not needs_grad:
-        out = pure(*arrays)
+        out, new_bufs = pure(*arrays)
+        write_bufs(new_bufs)
         single = not isinstance(out, (tuple, list))
         outs = [out] if single else list(out)
         wrapped = [Tensor._wrap(o) for o in outs]
         return wrapped[0] if single else tuple(wrapped)
 
     ckpt = jax.checkpoint(pure)
-    out, vjp_fn = jax.vjp(ckpt, *arrays)
+    out, vjp_fn, new_bufs = jax.vjp(ckpt, *arrays, has_aux=True)
+    write_bufs(new_bufs)
     single = not isinstance(out, (tuple, list))
     outs = [out] if single else list(out)
     specs = [(o.shape, o.dtype) for o in outs]

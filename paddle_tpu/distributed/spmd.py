@@ -106,10 +106,11 @@ class DistributedTrainStep:
         # trainable ∩ optimizer-owned params (frozen params stay baked as
         # replicated constants; accumulator slots indexed via _acc_idx)
         opt_index = {id(p): j for j, p in enumerate(optimizer._parameter_list)}
-        self._params = [p for p in model.parameters()
-                        if not p.stop_gradient and id(p) in opt_index]
+        from paddle_tpu.jit.api import dedup_params, model_buffers
+        self._params = dedup_params(
+            p for p in model.parameters()
+            if not p.stop_gradient and id(p) in opt_index)
         self._acc_idx = [opt_index[id(p)] for p in self._params]
-        from paddle_tpu.jit.api import model_buffers
         self._buffers = model_buffers(model)
         self._jitted = None
         self._donate = donate

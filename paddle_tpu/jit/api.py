@@ -353,10 +353,24 @@ def not_to_static(fn):
     return fn
 
 
+def dedup_params(params):
+    """Identity-dedup for parameter/buffer lists: a layer registered
+    under two parents (shared submodules) must not produce a
+    twice-donated array."""
+    seen, out = set(), []
+    for p in params:
+        if id(p) not in seen:
+            seen.add(id(p))
+            out.append(p)
+    return out
+
+
 def model_buffers(model):
     """The ordered buffer list threaded through compiled steps (must be
-    identical between make_forward_loss and the caller's writeback)."""
-    return list(model.buffers()) if hasattr(model, "buffers") else []
+    identical between make_forward_loss and the caller's writeback),
+    identity-deduplicated."""
+    return dedup_params(model.buffers() if hasattr(model, "buffers")
+                        else [])
 
 
 def make_forward_loss(model, loss_fn, params, with_outputs=False,
@@ -649,8 +663,9 @@ class TrainStep:
         # params) and the optimizer's parameter list (whose accumulator
         # slots we must index consistently).
         opt_index = {id(p): j for j, p in enumerate(optimizer._parameter_list)}
-        self._params = [p for p in model.parameters()
-                        if not p.stop_gradient and id(p) in opt_index]
+        self._params = dedup_params(
+            p for p in model.parameters()
+            if not p.stop_gradient and id(p) in opt_index)
         self._acc_idx = [opt_index[id(p)] for p in self._params]
         # buffers thread through the compiled step so in-forward updates
         # (BN running stats, spectral-norm u/v) persist across steps

@@ -164,7 +164,9 @@ def complex(real, imag, name=None):
     # float width follows the inputs (float64 → complex128 where x64 is
     # enabled), not a hard-coded float32
     fdt = jnp.promote_types(r._array.dtype, i._array.dtype)
-    if not jnp.issubdtype(fdt, jnp.floating):
+    if not jnp.issubdtype(fdt, jnp.floating) or \
+            jnp.finfo(fdt).bits < 32:
+        # lax.complex accepts only f32/f64; sub-32-bit floats widen
         fdt = jnp.dtype(jnp.float32)
 
     def fn(a, b):

@@ -234,15 +234,15 @@ def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[h] = dv.astype(dv_ref.dtype)
 
 
-def _shortseq_hb(BH, S=512, D=64):
+def _shortseq_hb(BH, S=512, D=64, itemsize=2):
     """Heads per program: largest divisor of B*H whose per-program VMEM
-    working set fits the ~16MB/core budget. Bwd per program:
-    5 in/out blocks of [hb,S,D] bf16 plus ~18*S*S bytes of per-head
-    score-sized intermediates (f32 s/p/dp + bf16 pb/ds — sequential
-    heads reuse the buffers). 12MB target leaves room for Mosaic's
-    double-buffered DMA."""
+    working set fits the ~16MB/core budget. Bwd per program: 8 in/out
+    blocks of [hb,S,D] (q/k/v/o/do/dq/dk/dv) at the input itemsize,
+    plus ~18*S*S bytes of per-head score-sized intermediates (f32
+    s/p/dp + bf16 pb/ds — sequential heads reuse the buffers). 12MB
+    target leaves room for Mosaic's double-buffered DMA."""
     budget = 12 * 1024 * 1024 - 18 * S * S
-    per_head = 5 * S * D * 2
+    per_head = 8 * S * D * itemsize
     for h in (6, 4, 3, 2):
         if BH % h == 0 and h * per_head <= max(budget, 0):
             return h
@@ -299,14 +299,14 @@ def _shortseq_call_bwd(q, k, v, o, do, lse, scale, hb, interpret=False):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _shortseq_attention(q, k, v, scale, interpret):
     o, _ = _shortseq_call_fwd(q, k, v, scale,
-                              _shortseq_hb(*q.shape),
+                              _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                               interpret=interpret)
     return o
 
 
 def _shortseq_vjp_fwd(q, k, v, scale, interpret):
     o, lse = _shortseq_call_fwd(q, k, v, scale,
-                                _shortseq_hb(*q.shape),
+                                _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                                 interpret=interpret)
     return o, (q, k, v, o, lse)
 
@@ -314,7 +314,7 @@ def _shortseq_vjp_fwd(q, k, v, scale, interpret):
 def _shortseq_vjp_bwd(scale, interpret, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _shortseq_call_bwd(q, k, v, o, do, lse, scale,
-                                    _shortseq_hb(*q.shape),
+                                    _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                                     interpret=interpret)
     return dq, dk, dv
 

@@ -235,3 +235,71 @@ def test_complex_fallback_grads_and_dtype(monkeypatch):
     loss.backward()
     np.testing.assert_allclose(np.asarray(r.grad._array), [2.0, 2.0])
     np.testing.assert_allclose(np.asarray(i.grad._array), [3.0, 3.0])
+
+
+def test_recompute_threads_bn_buffers():
+    """recompute (jax.checkpoint) composed with BatchNorm inside a
+    compiled TrainStep: no tracer leak, and running stats advance
+    (the buffer updates ride the vjp aux, r4 fix)."""
+    from paddle_tpu.distributed.recompute import recompute
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.block = nn.Sequential(
+                nn.Conv2D(3, 4, 3, padding=1), nn.BatchNorm2D(4),
+                nn.ReLU())
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            h = recompute(self.block, x)
+            return self.fc(h.mean(axis=[2, 3]))
+
+    paddle.seed(0)
+    model = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    step = jit.TrainStep(model, opt, _loss)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 3, 8, 8).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    bn = model.block[1]
+    m0 = np.asarray(bn._mean._array).copy()
+    w0 = np.asarray(model.block[0].weight._array).copy()
+    step(x, y)
+    assert not np.allclose(m0, np.asarray(bn._mean._array)), \
+        "BN stats did not advance through recompute"
+    assert not np.allclose(w0, np.asarray(model.block[0].weight._array)), \
+        "grads did not reach the rematted block's params"
+
+
+def test_shared_sublayer_no_double_donation():
+    """A layer registered under two parents yields duplicate
+    parameters()/buffers() entries; the compiled step must dedup them
+    (duplicates crash XLA donation with INVALID_ARGUMENT, r4 fix)."""
+
+    class Shared(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.body = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+            self.alias = self.body  # second registration, same object
+            self.bn = nn.BatchNorm1D(4)
+            self.bn_alias = self.bn
+            self.out = nn.Linear(4, 2)
+
+        def forward(self, x):
+            h = self.alias(self.body(x))
+            h = self.bn(h.unsqueeze(-1)).squeeze(-1)
+            return self.out(h)
+
+    paddle.seed(0)
+    model = Shared()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    step = jit.TrainStep(model, opt, _loss)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 4).astype(np.float32))
+    y = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    l0 = float(step(x, y))
+    l1 = float(step(x, y))
+    assert np.isfinite(l0) and np.isfinite(l1)

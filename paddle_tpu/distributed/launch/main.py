@@ -37,8 +37,28 @@ def build_parser():
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.distributed.launch",
         description="spawn a collective job: one process per rank")
-    p.add_argument("--nprocs", "--nnodes", type=int, default=1,
-                   help="number of ranks (processes) to launch")
+    p.add_argument("--nprocs", type=int, default=1,
+                   help="number of ranks (processes) to launch "
+                        "(single-node form; see --nnodes for the "
+                        "node x procs-per-node form)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes in the job (reference "
+                        "launch --nnodes). With --nprocs-per-node M "
+                        "the world size is nnodes*M and rank = "
+                        "node_rank*M + local_rank")
+    p.add_argument("--nprocs-per-node", type=int, default=0,
+                   help="ranks per node (reference's per-node proc "
+                        "count). 0 = classic --nprocs mode")
+    p.add_argument("--node-rank", type=int, default=None,
+                   help="this invocation's node index: spawn ONLY that "
+                        "node's local ranks (real multi-host use — one "
+                        "launcher per host, shared --master). Default: "
+                        "simulate ALL nodes on this host")
+    p.add_argument("--servers", type=int, default=0,
+                   help="parameter-server processes to launch alongside "
+                        "the trainers (TRAINING_ROLE=PSERVER; the "
+                        "script should branch on paddle.distributed."
+                        "ps.service.is_server() and call run_server())")
     p.add_argument("--master", default=None,
                    help="coordinator ip:port (default: 127.0.0.1:<free port>)")
     p.add_argument("--backend", default=None, choices=[None, "cpu", "tpu"],
@@ -52,27 +72,53 @@ def build_parser():
                         "up to N times after a rank failure (the "
                         "ElasticManager watch/restart analog, "
                         "fleet/elastic/manager.py)")
+    p.add_argument("--elastic-min", type=int, default=0,
+                   help="elastic scale-in: on each restart drop one rank "
+                        "(a lost host leaves the pod) down to this "
+                        "minimum — ranks renumber 0..n-1 and the new "
+                        "world re-rendezvouses; 0 disables (restarts "
+                        "keep the original size). Scripts resume from "
+                        "their checkpoint under the new "
+                        "PADDLE_TRAINERS_NUM (elastic/manager.py:126 "
+                        "membership-change analog)")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
 
 
-def _rank_env(args, rank: int, master: str) -> dict:
+def _world_size(args) -> int:
+    if args.nprocs_per_node:
+        return args.nnodes * args.nprocs_per_node
+    return args.nprocs
+
+
+def _rank_env(args, rank: int, master: str, server_rank=None,
+              node_rank=None) -> dict:
     from paddle_tpu.distributed.spawn import rank_env_overrides
 
     env = dict(os.environ)
-    for k, v in rank_env_overrides(rank, args.nprocs, master, args.backend,
-                                   args.devices_per_proc).items():
+    for k, v in rank_env_overrides(rank, _world_size(args), master,
+                                   args.backend, args.devices_per_proc,
+                                   nservers=args.servers,
+                                   server_rank=server_rank).items():
         if v is None:
             env.pop(k, None)
         else:
             env[k] = v
+    if args.nprocs_per_node and server_rank is None:
+        # node topology env (reference: PADDLE_TRAINERS_NUM plus the
+        # node/local split the multi-node launcher derives rank from)
+        env["PADDLE_NNODES"] = str(args.nnodes)
+        env["PADDLE_NODE_RANK"] = str(node_rank)
+        env["PADDLE_LOCAL_RANK"] = str(rank -
+                                       node_rank * args.nprocs_per_node)
+        env["PADDLE_LOCAL_SIZE"] = str(args.nprocs_per_node)
     return env
 
 
-def _stream(proc, rank):
+def _stream(proc, label):
     for line in proc.stdout:
-        sys.stdout.write(f"[rank {rank}] {line.decode(errors='replace')}")
+        sys.stdout.write(f"[{label}] {line.decode(errors='replace')}")
         sys.stdout.flush()
 
 
@@ -91,10 +137,24 @@ def launch(argv=None) -> int:
         master = f"127.0.0.1:{probe.getsockname()[1]}"
     rc = _launch_once(args, master, probe)
     # elastic restart loop (ElasticManager.watch -> restart analog):
-    # a failed pod is torn down and relaunched whole, same endpoints
+    # a failed pod is torn down and relaunched — whole by default, or
+    # scaled in by one rank per restart with --elastic-min (the
+    # membership-change path: the new pod re-rendezvouses at the
+    # smaller world size and scripts resume from their checkpoint)
     restarts = 0
     while rc != 0 and restarts < args.max_restarts:
         restarts += 1
+        if args.elastic_min and args.nprocs_per_node:
+            if args.nnodes > args.elastic_min:
+                args.nnodes -= 1  # a lost NODE leaves the pod
+                sys.stderr.write(
+                    f"[launch] scale-in: relaunching with "
+                    f"{args.nnodes} nodes\n")
+        elif args.elastic_min and args.nprocs > args.elastic_min:
+            args.nprocs -= 1
+            sys.stderr.write(
+                f"[launch] scale-in: relaunching with "
+                f"{args.nprocs} ranks\n")
         sys.stderr.write(
             f"[launch] pod failed (rc={rc}); restart "
             f"{restarts}/{args.max_restarts}\n")
@@ -109,9 +169,28 @@ def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
     # spawn AND watch inside one try so a mid-spawn failure still tears
     # down the ranks already started
     rc = 0
+    # (kind, rank, node): trainers first, then PS server processes
+    if args.nprocs_per_node:
+        per = args.nprocs_per_node
+        nodes = [args.node_rank] if args.node_rank is not None \
+            else range(args.nnodes)
+        members = [("trainer", node * per + local, node)
+                   for node in nodes for local in range(per)]
+        if args.node_rank not in (None, 0) and not args.master:
+            raise SystemExit("--node-rank > 0 needs --master "
+                             "(the coordinator lives on node 0)")
+    else:
+        members = [("trainer", r, 0) for r in range(args.nprocs)]
+    if args.node_rank in (None, 0):
+        # PS servers live on node 0 only: with per-host launchers every
+        # node would otherwise spawn colliding server ranks
+        members += [("server", s, 0) for s in range(args.servers)]
     try:
-        for rank in range(args.nprocs):
-            env = _rank_env(args, rank, master)
+        for kind, rank, node in members:
+            env = _rank_env(args, rank, master,
+                            server_rank=rank if kind == "server"
+                            else None,
+                            node_rank=node)
             if probe is not None:
                 # release the coordinator port at the last moment (rank
                 # 0's bind happens moments later; a same-port steal now
@@ -119,13 +198,14 @@ def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
                 # env-setup span)
                 probe.close()
                 probe = None
+            label = f"rank{rank}" if kind == "trainer" else f"ps{rank}"
             if args.log_dir:
                 os.makedirs(args.log_dir, exist_ok=True)
                 # attempt-suffixed on elastic restarts: the failed
                 # attempt's logs are the crash evidence — keep them
                 suffix = "" if attempt == 0 else f".restart{attempt}"
                 logf = open(os.path.join(
-                    args.log_dir, f"rank{rank}{suffix}.log"), "w")
+                    args.log_dir, f"{label}{suffix}.log"), "w")
                 logs.append(logf)
                 proc = subprocess.Popen(
                     [sys.executable, args.script] + args.script_args,
@@ -134,7 +214,7 @@ def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
                 proc = subprocess.Popen(
                     [sys.executable, args.script] + args.script_args,
                     env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-                t = threading.Thread(target=_stream, args=(proc, rank))
+                t = threading.Thread(target=_stream, args=(proc, label))
                 t.daemon = True
                 t.start()
                 streams.append(t)

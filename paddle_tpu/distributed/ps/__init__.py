@@ -4,11 +4,21 @@ The reference runs dedicated brpc parameter-server processes
 (distributed/ps/service/brpc_ps_server.h) holding sharded sparse tables
 (table/memory_sparse_table.h) with pluggable accessors/SGD rules; the
 TPU-native design keeps the table/accessor/pull/push taxonomy
-(ps/README.md) but serves shards from the TPU hosts' own RAM and rides
-the eager alltoall for the id exchange (SURVEY §7 PS row).
+(ps/README.md) with TWO service modes:
+- in-trainer (table.py): shards live in the TPU hosts' own RAM and the
+  id exchange rides the eager alltoall — the sync-collective mode;
+- service tier (service.py): standalone table-server processes reached
+  over rpc, with a trainer-side Communicator in sync / async / geo
+  modes — the brpc PS server + communicator.py analog. Launch with
+  `--servers N`.
 """
+from . import service
 from .embedding import DistributedEmbedding
+from .service import (Communicator, TableClient, init_ps_rpc, is_server,
+                      is_worker, run_server, stop_servers)
 from .table import MemorySparseTable, SparseAdagradRule, SparseSGDRule
 
 __all__ = ["MemorySparseTable", "SparseAdagradRule", "SparseSGDRule",
-           "DistributedEmbedding"]
+           "DistributedEmbedding", "service", "TableClient",
+           "Communicator", "init_ps_rpc", "is_server", "is_worker",
+           "run_server", "stop_servers"]

@@ -1,0 +1,392 @@
+"""PS service tier: standalone table-server processes + the trainer-side
+communicator.
+
+Reference analogs:
+- server: paddle/fluid/distributed/ps/service/brpc_ps_server.h (table
+  RPC service), python/paddle/distributed/ps/the_one_ps.py
+  (init_server/run_server lifecycle);
+- client: brpc_ps_client pull_sparse/push_sparse;
+- communicator: python/paddle/distributed/communicator.py — the
+  sync / a_sync (async) / geo push modes of fleet's PS training.
+
+TPU-native shape: the transport is paddle_tpu.distributed.rpc (TCP +
+pickle between trusted hosts — the same trust model as brpc). Trainers
+and servers form ONE rpc world: trainer ranks [0, T) named
+"trainer:<i>", server ranks [T, T+S) named "server:<j>". A server
+process hosts one hash-slice (id % S == j) of every named table in its
+RAM and applies accessor updates on push; it never touches a TPU.
+Launch with `python -m paddle_tpu.distributed.launch --nprocs T
+--servers S train.py` — server processes get TRAINING_ROLE=PSERVER and
+should call `run_server()`.
+
+Modes (Communicator):
+- sync: push RPCs complete before the step returns (the default
+  sync-PS semantics — every trainer's pull sees all prior pushes).
+- async: pushes ride a bounded background queue; pulls proceed without
+  waiting (the reference's a_sync=True communicator — bounded
+  staleness, higher throughput).
+- geo: per-id gradient deltas accumulate locally and ship every
+  `k_steps` pushes (GeoCommunicator / geo-sgd).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+import numpy as np
+
+from .table import MemorySparseTable, SparseAdagradRule, SparseSGDRule
+
+__all__ = [
+    "role", "is_server", "is_worker", "num_servers", "num_trainers",
+    "server_index", "trainer_index", "init_ps_rpc", "run_server",
+    "stop_servers", "TableClient", "Communicator",
+]
+
+
+# ---------------------------------------------------------------------------
+# roles (reference: TRAINING_ROLE env contract of fleet PS mode)
+# ---------------------------------------------------------------------------
+
+def role() -> str:
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+
+
+def is_server() -> bool:
+    return role() == "PSERVER"
+
+
+def is_worker() -> bool:
+    return not is_server()
+
+
+def num_trainers() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def num_servers() -> int:
+    return int(os.environ.get("PADDLE_PSERVER_NUM", "0"))
+
+
+def trainer_index() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def server_index() -> int:
+    return int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+
+
+def init_ps_rpc(master_endpoint=None):
+    """Join the trainer+server rpc world under this process's role."""
+    from paddle_tpu.distributed import rpc
+
+    world = num_trainers() + num_servers()
+    if is_server():
+        name = f"server:{server_index()}"
+        rank = num_trainers() + server_index()
+    else:
+        name = f"trainer:{trainer_index()}"
+        rank = trainer_index()
+    return rpc.init_rpc(name, rank=rank, world_size=world,
+                        master_endpoint=master_endpoint)
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+_TABLES: dict = {}          # name -> MemorySparseTable
+_TABLE_LOCKS: dict = {}     # name -> Lock (rpc handler threads race)
+_CREATE_LOCK = threading.Lock()
+_STOP = threading.Event()
+_STOP_CALLERS: set = set()
+_STOP_LOCK = threading.Lock()
+
+_RULES = {"sgd": SparseSGDRule, "adagrad": SparseAdagradRule}
+
+
+def _srv_ensure_table(name, dim, rule_kind, rule_kwargs, seed):
+    """Idempotent table creation (every trainer configures every
+    server; first call wins — guarded: concurrent ensure RPCs from two
+    trainers must not each create and clobber the other's table)."""
+    with _CREATE_LOCK:
+        if name not in _TABLES:
+            rule = _RULES[rule_kind](**rule_kwargs)
+            _TABLE_LOCKS[name] = threading.Lock()
+            _TABLES[name] = MemorySparseTable(
+                dim, rule=rule, nshards=1, seed=seed, name=name,
+                per_id_init=True)
+    return True
+
+
+def _srv_pull(name, ids):
+    with _TABLE_LOCKS[name]:
+        return _TABLES[name].pull(np.asarray(ids, np.int64))
+
+
+def _srv_push(name, ids, grads):
+    with _TABLE_LOCKS[name]:
+        _TABLES[name].push(np.asarray(ids, np.int64),
+                           np.asarray(grads, np.float32))
+    return True
+
+
+def _srv_touched(name):
+    with _TABLE_LOCKS[name]:
+        return _TABLES[name].touched
+
+
+def _srv_state_dict(name):
+    with _TABLE_LOCKS[name]:
+        return _TABLES[name].state_dict()
+
+
+def _srv_set_state_dict(name, state):
+    with _TABLE_LOCKS[name]:
+        _TABLES[name].set_state_dict(state)
+    return True
+
+
+def _srv_stop(caller):
+    """A server exits once EVERY trainer has said stop (a crashed pod
+    is torn down by the launcher instead)."""
+    with _STOP_LOCK:
+        _STOP_CALLERS.add(caller)
+        if len(_STOP_CALLERS) >= num_trainers():
+            _STOP.set()
+    return True
+
+
+def run_server(master_endpoint=None):
+    """Server-process main: join the rpc world, serve table RPCs until
+    all trainers call stop_servers(). (the_one_ps run_server analog —
+    the serving itself is the rpc module's daemon handler threads.)"""
+    from paddle_tpu.distributed import rpc
+
+    init_ps_rpc(master_endpoint)
+    _STOP.wait()
+    rpc.shutdown()
+
+
+def stop_servers():
+    """Trainer-side: tell every server this trainer is done."""
+    from paddle_tpu.distributed import rpc
+
+    me = trainer_index()
+    for j in range(num_servers()):
+        rpc.rpc_sync(f"server:{j}", _srv_stop, args=(me,))
+
+
+# ---------------------------------------------------------------------------
+# trainer side
+# ---------------------------------------------------------------------------
+
+def _rule_spec(rule):
+    if rule is None:
+        return "adagrad", {}
+    if isinstance(rule, SparseSGDRule):
+        return "sgd", {"learning_rate": rule.lr}
+    if isinstance(rule, SparseAdagradRule):
+        return "adagrad", {"learning_rate": rule.lr,
+                           "initial_g2sum": rule.g0, "eps": rule.eps}
+    raise ValueError(f"unknown accessor rule {type(rule).__name__}; "
+                     "sync it to the server with a (kind, kwargs) pair")
+
+
+class TableClient:
+    """Trainer-side handle to a table sharded over the server
+    processes (brpc_ps_client pull_sparse/push_sparse analog). Same
+    pull/push surface as MemorySparseTable, so DistributedEmbedding
+    takes it via its `table=` argument unchanged."""
+
+    def __init__(self, name, dim, rule=None, seed=0, communicator=None):
+        from paddle_tpu.distributed import rpc
+
+        self.name = name
+        self.dim = dim
+        self._servers = sorted(
+            (w.name for w in rpc.get_all_worker_infos()
+             if w.name.startswith("server:")),
+            key=lambda n: int(n.split(":")[1]))
+        if not self._servers:
+            raise RuntimeError(
+                "no PS servers in the rpc world — launch with "
+                "--servers N and call init_ps_rpc() first")
+        kind, kwargs = _rule_spec(rule)
+        for s in self._servers:
+            rpc.rpc_sync(s, _srv_ensure_table,
+                         args=(name, dim, kind, kwargs, seed))
+        self.communicator = communicator
+        if communicator is not None:
+            communicator.bind(self)
+
+    def _owner(self, ids):
+        return np.asarray(ids) % len(self._servers)
+
+    def pull(self, ids):
+        from paddle_tpu.distributed import rpc
+
+        ids = np.asarray(ids, np.int64).ravel()
+        owners = self._owner(ids)
+        futs = {}
+        for j, s in enumerate(self._servers):
+            sel = ids[owners == j]
+            if len(sel):
+                futs[j] = rpc.rpc_async(s, _srv_pull,
+                                        args=(self.name, sel))
+        out = np.empty((len(ids), self.dim), np.float32)
+        for j, f in futs.items():
+            out[owners == j] = f.result()
+        return out
+
+    def push(self, ids, grads):
+        if self.communicator is not None:
+            self.communicator.push(ids, grads)
+        else:
+            self.push_direct(ids, grads)
+
+    def push_direct(self, ids, grads, wait=True):
+        from paddle_tpu.distributed import rpc
+
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        owners = self._owner(ids)
+        futs = []
+        for j, s in enumerate(self._servers):
+            m = owners == j
+            if m.any():
+                futs.append(rpc.rpc_async(
+                    s, _srv_push, args=(self.name, ids[m], grads[m])))
+        if wait:
+            for f in futs:
+                f.result()
+        return futs
+
+    def touched(self):
+        from paddle_tpu.distributed import rpc
+
+        return sum(rpc.rpc_sync(s, _srv_touched, args=(self.name,))
+                   for s in self._servers)
+
+    def state_dict(self):
+        from paddle_tpu.distributed import rpc
+
+        out = {}
+        for s in self._servers:
+            out.update(rpc.rpc_sync(s, _srv_state_dict,
+                                    args=(self.name,)))
+        return out
+
+    def set_state_dict(self, state):
+        """Restore a checkpoint: rows route to their owning server by
+        id (id keys make the checkpoint independent of the server
+        count, like MemorySparseTable.set_state_dict)."""
+        from paddle_tpu.distributed import rpc
+
+        per_server: dict = {j: {} for j in range(len(self._servers))}
+        for key, row_state in state.items():
+            per_server[int(key) % len(self._servers)][key] = row_state
+        futs = [rpc.rpc_async(s, _srv_set_state_dict,
+                              args=(self.name, per_server[j]))
+                for j, s in enumerate(self._servers) if per_server[j]]
+        for f in futs:
+            f.result()
+
+
+class Communicator:
+    """The push-side scheduler (python/paddle/distributed/
+    communicator.py analog). mode:
+    - "sync": push completes inline;
+    - "async": bounded background queue (a_sync communicator) —
+      `queue_size` caps staleness; flush() drains;
+    - "geo": per-id delta accumulation, shipped every `k_steps` pushes
+      (GeoCommunicator).
+
+    Transport-agnostic: pushes go through the bound TableClient's
+    push_direct, so the merge/queue logic unit-tests without servers.
+    """
+
+    def __init__(self, mode="async", k_steps=4, queue_size=64):
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"mode={mode!r}; expected sync|async|geo")
+        self.mode = mode
+        self.k_steps = int(k_steps)
+        self._client = None
+        self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
+        self._thread = None
+        self._err = None
+        self._geo_acc: dict = {}
+        self._geo_count = 0
+        self._lock = threading.Lock()
+
+    def bind(self, client):
+        self._client = client
+        if self.mode == "async" and self._thread is None:
+            self._thread = threading.Thread(target=self._drain,
+                                            daemon=True)
+            self._thread.start()
+
+    def _drain(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                ids, grads = item
+                self._client.push_direct(ids, grads, wait=True)
+            except Exception as e:  # surface on the next push/flush
+                self._err = e
+            finally:
+                self._queue.task_done()
+
+    def push(self, ids, grads):
+        if self._err is not None:
+            raise self._err
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(
+            len(ids), self._client.dim)
+        if self.mode == "sync":
+            self._client.push_direct(ids, grads, wait=True)
+        elif self.mode == "async":
+            self._queue.put((ids, grads))  # blocks at queue_size: the
+            # staleness bound of a_sync mode
+        else:  # geo
+            with self._lock:
+                for i, g in zip(ids, grads):
+                    i = int(i)
+                    if i in self._geo_acc:
+                        self._geo_acc[i] += g
+                    else:
+                        self._geo_acc[i] = g.copy()
+                self._geo_count += 1
+                ship = self._geo_count >= self.k_steps
+            if ship:
+                self._ship_geo()
+
+    def _ship_geo(self):
+        with self._lock:
+            acc, self._geo_acc = self._geo_acc, {}
+            self._geo_count = 0
+        if acc:
+            ids = np.fromiter(acc.keys(), np.int64, len(acc))
+            grads = np.stack(list(acc.values()))
+            self._client.push_direct(ids, grads, wait=True)
+
+    def flush(self):
+        """Drain every outstanding push (end of epoch / before eval /
+        before checkpoint): queue.join waits for the in-flight push
+        too (task_done fires after push_direct returns)."""
+        if self.mode == "async":
+            self._queue.join()
+        elif self.mode == "geo":
+            self._ship_geo()
+        if self._err is not None:
+            raise self._err
+
+    def stop(self):
+        if self._thread is not None:
+            self.flush()
+            self._queue.put(None)
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -60,19 +60,35 @@ class SparseAdagradRule:
 
 
 class _Shard:
-    """One hash shard: id -> (row, accessor state), lazily created."""
+    """One hash shard: id -> (row, accessor state), lazily created.
 
-    def __init__(self, dim, rule, initializer, seed):
+    per_id_init=True derives each row's rng from (seed, id) instead of
+    the shard's materialization order — the same id then initializes
+    identically under ANY sharding/process/server topology, which is
+    what makes sync-vs-async PS runs comparable (and checkpoints
+    portable before first touch)."""
+
+    def __init__(self, dim, rule, initializer, seed, per_id_init=False,
+                 base_seed=None):
         self.dim = dim
         self.rule = rule
         self.rows: dict[int, np.ndarray] = {}
         self.states: dict[int, np.ndarray] = {}
         self._init = initializer
+        # per-id rng derives from the TABLE's base seed, never the
+        # shard-varying seed — otherwise the same id would initialize
+        # differently under a different nshards/process topology,
+        # breaking the portability the mode exists for
+        self._base_seed = seed if base_seed is None else base_seed
+        self._per_id = per_id_init
         self._rng = np.random.RandomState(seed)
 
     def _materialize(self, i):
         if i not in self.rows:
-            self.rows[i] = self._init(self._rng, self.dim).astype(np.float32)
+            rng = np.random.RandomState(
+                (self._base_seed * 1000003 + i) & 0x7FFFFFFF) \
+                if self._per_id else self._rng
+            self.rows[i] = self._init(rng, self.dim).astype(np.float32)
             self.states[i] = self.rule.init_state(self.dim)
         return self.rows[i]
 
@@ -104,7 +120,7 @@ class MemorySparseTable:
     """
 
     def __init__(self, dim, rule=None, nshards=None, initializer=None,
-                 seed=0, name="sparse_table"):
+                 seed=0, name="sparse_table", per_id_init=False):
         import jax
 
         self.dim = dim
@@ -119,9 +135,12 @@ class MemorySparseTable:
         if self._nproc > 1:
             # one local shard: the slice of the hash space this host owns
             self._shards = {self._rank: _Shard(dim, self.rule, init,
-                                               seed + self._rank)}
+                                               seed + self._rank,
+                                               per_id_init,
+                                               base_seed=seed)}
         else:
-            self._shards = {s: _Shard(dim, self.rule, init, seed + s)
+            self._shards = {s: _Shard(dim, self.rule, init, seed + s,
+                                      per_id_init, base_seed=seed)
                             for s in range(self.nshards)}
 
     # -- local (single-process) path ------------------------------------

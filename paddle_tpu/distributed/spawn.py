@@ -18,15 +18,34 @@ __all__ = ["spawn"]
 
 
 def rank_env_overrides(rank, nprocs, master, backend=None,
-                       devices_per_proc=1):
+                       devices_per_proc=1, nservers=0, server_rank=None):
     """The collective env contract for one rank, as an overrides dict
     (value None = unset). SHARED by dist.spawn and the launcher CLI —
-    the single definition of PADDLE_*/MASTER_*/backend env."""
+    the single definition of PADDLE_*/MASTER_*/backend env.
+    server_rank is not None => a PS server process (TRAINING_ROLE=
+    PSERVER): servers join the rpc world but never the device
+    collective, so they are pinned to the CPU backend."""
+    if server_rank is not None:
+        env = {
+            "TRAINING_ROLE": "PSERVER",
+            "PADDLE_PSERVER_ID": str(server_rank),
+            "PADDLE_PSERVER_NUM": str(nservers),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_MASTER": master,
+            # a table server must not grab a TPU chip
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": None,
+        }
+        env["MASTER_ADDR"], env["MASTER_PORT"] = master.split(":")
+        return env
     env = {
+        "TRAINING_ROLE": "TRAINER",
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nprocs),
         "PADDLE_MASTER": master,
     }
+    if nservers:
+        env["PADDLE_PSERVER_NUM"] = str(nservers)
     env["MASTER_ADDR"], env["MASTER_PORT"] = master.split(":")
     if backend == "cpu":
         env["JAX_PLATFORMS"] = "cpu"

@@ -92,11 +92,19 @@ class Config:
         self._micro_batch_size = int(n)
 
     def set_dist_degrees(self, dp: int = 1, mp: int = 1):
-        if int(dp) != 1 or int(mp) != 1:
+        """Serve the loaded artifact dp-way data-parallel on the local
+        mesh: the deserialized exported program is called inside an
+        outer pjit whose batch inputs are 'dp'-sharded — XLA's SPMD
+        partitioner re-partitions the single-device program
+        (dist_model.cc resharding analog). mp>1 needs layer-level
+        dist_specs, which a saved artifact no longer has — build a
+        DistModel from the live nn.Layer for that."""
+        if int(mp) != 1:
             raise NotImplementedError(
-                "a saved exported program has fixed shardings; for mesh-"
-                "sharded serving build a DistModel from the nn.Layer: "
-                "DistModel(DistModelConfig(layer=..., dp=..., mp=...))")
+                "mp>1 over a saved artifact: weight shardings are not "
+                "recorded in the exported program; serve from the "
+                "layer: DistModel(DistModelConfig(layer=..., mp=...))")
+        self._dp = int(dp)
 
     # no-op knobs kept for reference-API parity (GPU/IR notions)
     def disable_gpu(self):
@@ -109,9 +117,43 @@ class Config:
         pass
 
 
+def _shard_translated(tl, dp):
+    """Wrap a loaded TranslatedLayer's exported program for dp-way
+    serving: weights replicate, batch inputs shard over a ('dp',) mesh,
+    and the outer jit lets XLA SPMD re-partition the single-device
+    program. Returns (run_fwd, dp)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.ops.dispatch import unwrap
+
+    devs = jax.devices()
+    if dp > len(devs):
+        raise ValueError(f"dp={dp} exceeds {len(devs)} devices")
+    mesh = Mesh(np.array(devs[:dp]), ("dp",))
+    repl = NamedSharding(mesh, P())
+    bs = NamedSharding(mesh, P("dp"))
+    state_args = [jax.device_put(np.asarray(a), repl)
+                  for a in tl._state_args]
+    exported = tl._exported
+
+    @jax.jit
+    def jitted(state, *xs):
+        return exported.call(state, *xs)
+
+    def run_fwd(*xs):
+        arrs = [jax.device_put(np.asarray(unwrap(x)), bs) for x in xs]
+        return jitted(state_args, *arrs)
+
+    return run_fwd
+
+
 class Predictor:
     """Loaded single-program predictor (AnalysisPredictor.Run parity:
-    list-of-arrays in, list-of-arrays out)."""
+    list-of-arrays in, list-of-arrays out). With
+    Config.set_dist_degrees(dp=N) the saved program serves N-way
+    data-parallel (batch sharded, weights replicated)."""
 
     def __init__(self, config: Config):
         from paddle_tpu.jit.save_load import load
@@ -126,14 +168,21 @@ class Predictor:
                 "enable_mixed_precision() needs a bf16 artifact; re-save "
                 "with paddle.jit.save(layer, path, input_spec=[...], "
                 "convert='bfloat16')")
+        self._forward = self._layer
+        if config._dp > 1:
+            if self._layer._exported is None:
+                raise ValueError("set_dist_degrees needs an executable "
+                                 "artifact (saved with input_spec)")
+            self._forward = _shard_translated(self._layer, config._dp)
 
     def get_input_names(self):
         spec = self._layer.input_spec or []
         return [s.get("name") or f"x{i}" for i, s in enumerate(spec)]
 
     def run(self, inputs: Sequence):
-        return _stream_micro_batches(self._layer, list(inputs),
-                                     self._config._micro_batch_size)
+        return _stream_micro_batches(self._forward, list(inputs),
+                                     self._config._micro_batch_size,
+                                     pad_to=self._config._dp)
 
     __call__ = run
 
@@ -194,7 +243,16 @@ class DistModel:
             from paddle_tpu.jit.save_load import load
 
             self._translated = load(cfg.model_path)
-            self._forward = self._run_translated
+            if cfg.mp != 1:
+                raise NotImplementedError(
+                    "mp>1 over a saved artifact (no recorded weight "
+                    "shardings); serve from the live layer instead")
+            if cfg.dp > 1 and self._translated._exported is not None:
+                # saved on 1 device, served dp-way: outer pjit reshards
+                self._forward = _shard_translated(self._translated,
+                                                  cfg.dp)
+            else:
+                self._forward = self._run_translated
         else:
             raise ValueError("DistModelConfig needs layer or model_path")
         return self

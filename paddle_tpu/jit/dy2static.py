@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["convert_ifelse", "convert_while_loop",
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_for_loop",
            "transform_function", "UNDEF"]
 
 
@@ -169,6 +169,102 @@ def convert_while_loop(cond_fn, body_fn, args):
             f"shapes/dtypes across iterations ({e})") from e
     full = rebuild(out)
     return tuple(full)
+
+
+class _RangeSpec:
+    """AST-detected `range(...)` iterable: bounds may be Tensors (the
+    reference's convert_range), so python range() must not see them."""
+
+    def __init__(self, *args):
+        if len(args) == 1:
+            self.start, self.stop, self.step = 0, args[0], 1
+        elif len(args) == 2:
+            (self.start, self.stop), self.step = args, 1
+        else:
+            self.start, self.stop, self.step = args
+
+
+def _range_cond(i, stop, step):
+    """Direction-aware bound check, traceable (operands may arrive as
+    Tensors re-wrapped by the while-loop carry)."""
+    i, stop, step = (_unwrap_one(x) for x in (i, stop, step))
+    if _is_traced(i) or _is_traced(stop) or _is_traced(step):
+        up = jnp.asarray(i) < jnp.asarray(stop)
+        down = jnp.asarray(i) > jnp.asarray(stop)
+        return jnp.where(jnp.asarray(step) > 0, up, down)
+    return i < stop if step > 0 else i > stop
+
+
+def convert_for_loop(iterable, body_fn, args, target_idx=None):
+    """convert_operators convert_for_loop/convert_range analog.
+    body_fn(item, *vars) -> vars. Three runtime forms:
+    - range with Tensor/traced bounds -> counter-carried lax.while_loop
+      (through convert_while_loop — the data-dependent decode-loop
+      path);
+    - Tensor/array iteration over axis 0 -> same loop with a
+      dynamic_index item (static python n, traced index);
+    - anything else (python range, lists, generators) -> exact python
+      iteration.
+
+    target_idx: position of a simple loop target within `args` — on the
+    traced paths the carry can't carry an initially-UNDEF target, so
+    its post-loop value is reconstructed from the counter (python
+    leaves the last item bound after the loop). A zero-trip traced
+    range leaves `start - step` there rather than python's unbound
+    (code reading the target of a loop that never ran is broken either
+    way)."""
+    if isinstance(iterable, _RangeSpec):
+        start, stop, step = (_unwrap_one(_cond_value(x))
+                             for x in (iterable.start, iterable.stop,
+                                       iterable.step))
+        if not any(map(_is_traced, (start, stop, step))):
+            for i in range(int(start), int(stop), int(step)):
+                args = tuple(body_fn(i, *args))
+            return args
+
+        def cond_fn(i, *vs):
+            return _range_cond(i, stop, step)
+
+        def body2(i, *vs):
+            out = tuple(body_fn(_wrap_one(i), *vs))
+            return (_unwrap_one(i) + step,) + out
+
+        out = convert_while_loop(cond_fn, body2,
+                                 (jnp.asarray(start),) + tuple(args))
+        final = list(out[1:])
+        if target_idx is not None:
+            final[target_idx] = _wrap_one(_unwrap_one(out[0]) - step)
+        return tuple(final)
+
+    arr = _unwrap_one(iterable) if isinstance(iterable, Tensor) \
+        else iterable
+    if isinstance(arr, jax.Array) or _is_traced(arr):
+        n = arr.shape[0]  # leading dim is static under jax
+        if not _is_traced(arr):
+            for i in range(n):
+                args = tuple(body_fn(_wrap_one(arr[i]), *args))
+            return args
+
+        def cond_fn(i, *vs):
+            return i < n
+
+        def body2(i, *vs):
+            item = jax.lax.dynamic_index_in_dim(arr, _unwrap_one(i),
+                                                keepdims=False)
+            out = tuple(body_fn(_wrap_one(item), *vs))
+            return (_unwrap_one(i) + 1,) + out
+
+        out = convert_while_loop(cond_fn, body2,
+                                 (jnp.asarray(0),) + tuple(args))
+        final = list(out[1:])
+        if target_idx is not None and n > 0:
+            final[target_idx] = _wrap_one(arr[n - 1])
+        return tuple(final)
+
+    # plain python iterable: exact python semantics
+    for item in iterable:
+        args = tuple(body_fn(item, *args))
+    return args
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +416,81 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             value=call, slice=ast.Constant(value=0), ctx=ast.Load()))
         return [as_fn(tname, node.body), as_fn(fname, node.orelse), ret]
 
+    # -- for --------------------------------------------------------------
+    @staticmethod
+    def _target_names(target):
+        v = _AssignedNames()
+        v.visit(target)
+        return v.names
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node  # for/else keeps python semantics
+        if _contains(node.body, (ast.Break, ast.Continue, ast.Return)):
+            return node  # loop-control/return: python semantics
+        tgt_names = self._target_names(node.target)
+        vars_ = sorted(_assigned(node.body) | tgt_names)
+        if not vars_:
+            return node
+        bname = self._name("forbody")
+        item = self._name("item")
+        out = []
+        for v in vars_:
+            out.append(ast.parse(
+                f"{v} = _paddle_jst.init_undef(lambda: {v})").body[0])
+        # body fn: (item, *vars) -> (*vars,); first stmt unpacks the
+        # loop target from item
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=item)] + [ast.arg(arg=v) for v in vars_],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        unpack = ast.Assign(targets=[node.target],
+                            value=ast.Name(id=item, ctx=ast.Load()))
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=v, ctx=ast.Load()) for v in vars_],
+            ctx=ast.Load()))
+        out.append(ast.FunctionDef(
+            name=bname, args=args,
+            body=[unpack] + list(node.body) + [ret],
+            decorator_list=[], returns=None))
+        # range(...) detected at AST level: bounds may be Tensors, so
+        # python range() must never see them (_RangeSpec carries them)
+        it = node.iter
+        if isinstance(it, ast.Call) and \
+                isinstance(it.func, ast.Name) and it.func.id == "range" \
+                and not it.keywords:
+            iter_expr = ast.Call(
+                func=ast.Attribute(
+                    value=ast.Name(id="_paddle_jst", ctx=ast.Load()),
+                    attr="_RangeSpec", ctx=ast.Load()),
+                args=list(it.args), keywords=[])
+        else:
+            iter_expr = it
+        # a simple-name target's position lets the runtime reconstruct
+        # its post-loop value on traced paths
+        tgt_idx = vars_.index(node.target.id) \
+            if isinstance(node.target, ast.Name) else None
+        call = ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_paddle_jst",
+                                              ctx=ast.Load()),
+                               attr="convert_for_loop", ctx=ast.Load()),
+            args=[iter_expr,
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Load())
+                                  for v in vars_], ctx=ast.Load())],
+            keywords=[ast.keyword(arg="target_idx",
+                                  value=ast.Constant(value=tgt_idx))])
+        tgt = ast.Tuple(elts=[ast.Name(id=v, ctx=ast.Store())
+                              for v in vars_], ctx=ast.Store()) \
+            if len(vars_) > 1 else ast.Name(id=vars_[0], ctx=ast.Store())
+        out.append(ast.Assign(
+            targets=[tgt],
+            value=call if len(vars_) > 1 else
+            ast.Subscript(value=call, slice=ast.Constant(value=0),
+                          ctx=ast.Load())))
+        return out
+
     # -- while ------------------------------------------------------------
     def visit_While(self, node):
         self.generic_visit(node)
@@ -380,7 +551,7 @@ def transform_function(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    if not _contains(fdef.body, (ast.If, ast.While)):
+    if not _contains(fdef.body, (ast.If, ast.While, ast.For)):
         return fn
     fdef.decorator_list = []
     _ControlFlowTransformer().visit(tree)

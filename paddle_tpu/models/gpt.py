@@ -211,6 +211,49 @@ class GPTForCausalLM(nn.Layer):
             mp.reshape(logits, [-1, self.config.vocab_size]),
             mp.reshape(labels, [-1]))
 
+    def generate(self, input_ids, max_length=None, eos_token_id=None):
+        """Greedy decode (generation_utils GenerationMixin.greedy_search
+        analog). Written as a data-dependent `while` over a fixed-size
+        token buffer so that under @to_static the WHOLE decode compiles
+        to ONE program with a lax.while_loop inside (dy2static
+        convert_while_loop — the run-to-completion decode loop); eager
+        calls run the same code as a python loop. No KV cache: each
+        step re-runs the causal forward over the buffer (the
+        correctness-first path; a cache is a pure optimization).
+
+        input_ids [B, S0] -> tokens [B, max_length] (positions past an
+        early EOS keep repeating EOS because `done` rows freeze)."""
+        import paddle_tpu as paddle
+
+        max_length = max_length or self.config.max_seq_len
+        B, S0 = input_ids.shape
+        if max_length < S0:
+            raise ValueError(f"max_length={max_length} < prompt {S0}")
+        pad = paddle.zeros([B, max_length - S0], dtype=input_ids.dtype)
+        tokens = mp.concat([input_ids, pad], axis=1)      # [B, L] static
+        positions = paddle.arange(max_length)             # [L]
+        # `done` derives from the (possibly traced) input so the loop
+        # condition is tensor-dependent from the first evaluation
+        done = (input_ids.sum(axis=1) * 0).astype("bool")  # [B] False
+        pos = S0
+        while paddle.logical_and(paddle.logical_not(done.all()),
+                                 paddle.to_tensor(pos < max_length)):
+            logits = self.forward(tokens)                 # [B, L, V]
+            # logits at pos-1 decide the token at pos (one-hot reduce:
+            # index `pos` is a traced scalar inside the compiled loop)
+            sel = (positions == (pos - 1)).astype(logits.dtype)
+            step_logits = (logits * sel.unsqueeze(0).unsqueeze(-1)) \
+                .sum(axis=1)                              # [B, V]
+            nxt = step_logits.argmax(axis=-1).astype(input_ids.dtype)
+            if eos_token_id is not None:
+                eos = paddle.full([1], eos_token_id, input_ids.dtype)
+                nxt = paddle.where(done, eos.expand([B]), nxt)
+                done = paddle.logical_or(done, nxt == eos_token_id)
+            write = (positions == pos).unsqueeze(0)       # [1, L]
+            tokens = paddle.where(write, nxt.unsqueeze(-1), tokens)
+            pos = pos + 1
+        return tokens
+
     def num_params(self):
         return sum(p.size for p in self.parameters())
 

@@ -24,14 +24,18 @@ class WideDeep(nn.Layer):
     """
 
     def __init__(self, num_fields, embedding_dim=8, hidden=(64, 32),
-                 sparse_lr=0.05, nshards=None):
+                 sparse_lr=0.05, nshards=None, deep_table=None,
+                 wide_table=None):
         super().__init__()
+        # explicit tables (e.g. ps.TableClient handles against the
+        # service tier) win over the default in-trainer host-RAM tables
         self.embedding = DistributedEmbedding(
-            0, embedding_dim, rule=SparseAdagradRule(sparse_lr),
+            0, embedding_dim, table=deep_table,
+            rule=SparseAdagradRule(sparse_lr),
             nshards=nshards, name="deep_table")
         self.wide = DistributedEmbedding(
-            0, 1, rule=SparseAdagradRule(sparse_lr), nshards=nshards,
-            name="wide_table")
+            0, 1, table=wide_table, rule=SparseAdagradRule(sparse_lr),
+            nshards=nshards, name="wide_table")
         layers, d = [], num_fields * embedding_dim
         for h in hidden:
             layers += [nn.Linear(d, h), nn.ReLU()]

@@ -1,0 +1,53 @@
+"""Elastic scale-in worker: trains a counter with per-step collectives,
+checkpoints every step, and SIGKILLs the last rank at step 5 on the
+first attempt. On the scaled-in relaunch (one fewer rank) every
+survivor resumes from the checkpoint and finishes.
+
+Usage (via launch --nprocs 3 --elastic-min 2 --max-restarts 1):
+    elastic_worker.py <ckpt.json> <kill_sentinel>
+"""
+import json
+import os
+import signal
+import sys
+
+import numpy as np
+
+
+def main():
+    ckpt_path, sentinel = sys.argv[1], sys.argv[2]
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+
+    start = 0
+    if os.path.exists(ckpt_path):
+        with open(ckpt_path) as f:
+            start = json.load(f)["step"]
+
+    for step in range(start, 10):
+        t = paddle.to_tensor(np.ones((1,), np.float32))
+        dist.all_reduce(t)  # proves the collective at the CURRENT size
+        assert float(np.asarray(t._array)[0]) == float(world)
+        if rank == 0:
+            tmp = ckpt_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"step": step + 1, "world": world}, f)
+            os.replace(tmp, ckpt_path)
+        dist.barrier()  # the checkpoint is visible before anyone dies
+        if (step == 5 and rank == world - 1
+                and not os.path.exists(sentinel)):
+            open(sentinel, "w").close()
+            print("KILLING self (simulated host loss)", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    print(f"ELASTIC_DONE rank={rank} world={world} resumed_from={start}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

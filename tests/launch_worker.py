@@ -383,6 +383,23 @@ def main():
     if phase in ("all", "localsgd"):
         run_localsgd(dist, paddle, rank, world,
                      out_file if phase == "localsgd" else None)
+    if phase == "twonode":
+        # two-node localhost simulation: check the node/local env split
+        # is consistent with the global rank, then run a collective
+        # across the full nnodes x per-node world
+        node = int(os.environ["PADDLE_NODE_RANK"])
+        local = int(os.environ["PADDLE_LOCAL_RANK"])
+        lsize = int(os.environ["PADDLE_LOCAL_SIZE"])
+        nnodes = int(os.environ["PADDLE_NNODES"])
+        assert rank == node * lsize + local, (rank, node, local, lsize)
+        assert world == nnodes * lsize, (world, nnodes, lsize)
+        t = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+        dist.all_reduce(t)
+        want = sum(range(world))
+        np.testing.assert_allclose(np.asarray(t._array),
+                                   np.full((2,), float(want)))
+        print(f"ok twonode node={node} local={local} rank={rank} "
+              f"world={world}", flush=True)
     print("WORKER_DONE", flush=True)
 
 

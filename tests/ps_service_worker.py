@@ -1,0 +1,84 @@
+"""Worker for the PS service-tier tests: launched via
+`python -m paddle_tpu.distributed.launch --nprocs T --servers S
+ps_service_worker.py <mode> <out_file>`.
+
+Server processes serve tables (run_server); trainer processes train
+wide&deep against TableClient handles with the given Communicator mode
+and write their final losses to <out_file>.<trainer_id>.
+"""
+import json
+import sys
+
+import numpy as np
+
+
+def main():
+    mode = sys.argv[1]
+    out_file = sys.argv[2] if len(sys.argv) > 2 else None
+
+    from paddle_tpu.distributed.ps import service
+
+    if service.is_server():
+        service.run_server()
+        print("SERVER_DONE", flush=True)
+        return
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.ps import (Communicator, SparseAdagradRule,
+                                           TableClient)
+    from paddle_tpu.models import WideDeep
+
+    service.init_ps_rpc()
+    tid = service.trainer_index()
+
+    comm = Communicator(mode=mode, k_steps=3)
+    deep_client = TableClient("deep_table", 8,
+                              rule=SparseAdagradRule(0.05), seed=0,
+                              communicator=comm)
+    wide_comm = Communicator(mode=mode, k_steps=3)
+    wide_client = TableClient("wide_table", 1,
+                              rule=SparseAdagradRule(0.05), seed=1,
+                              communicator=wide_comm)
+
+    paddle.seed(0)
+    model = WideDeep(4, embedding_dim=8, hidden=(32,),
+                     deep_table=deep_client, wide_table=wide_client)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=model.parameters())
+
+    # disjoint id slices per trainer so async staleness can't flip
+    # convergence; click iff field-0 id is even (same task as
+    # tests/test_ps.py::test_wide_deep_trains)
+    rs = np.random.RandomState(100 + tid)
+    ids_np = (rs.randint(0, 500, size=(128, 4)) * 2 +
+              tid).astype(np.int64)
+    y_np = (ids_np[:, :1] % 2 == 0).astype(np.float32)
+
+    losses = []
+    for epoch in range(30):
+        p = model(paddle.to_tensor(ids_np))
+        loss = F.binary_cross_entropy(p, paddle.to_tensor(y_np))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        model.push_sparse()
+        losses.append(float(loss))
+    comm.flush()
+    wide_comm.flush()
+    comm.stop()
+    wide_comm.stop()
+
+    touched = deep_client.touched()
+    sd = deep_client.state_dict()
+    if out_file:
+        with open(f"{out_file}.{tid}", "w") as f:
+            json.dump({"losses": losses, "touched": touched,
+                       "state_rows": len(sd)}, f)
+    print(f"TRAINER_DONE loss0={losses[0]:.4f} "
+          f"lossN={losses[-1]:.4f} touched={touched}", flush=True)
+    service.stop_servers()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Packaging + CI bench regression gate (VERDICT r3 missing #4).
+
+Reference analogs: tools/check_op_benchmark_result.py,
+tools/ci_model_benchmark.sh, setup.py (packaging).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "tools", "check_bench_result.py")
+
+
+def _run(args):
+    return subprocess.run([sys.executable, GATE] + args,
+                          capture_output=True, text=True, timeout=120)
+
+
+def _bench_lines(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_gate_passes_within_threshold(tmp_path):
+    base = {"m1": {"metric": "m1", "value": 100.0, "unit": "x/s"}}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "m1", "value": 95.0, "unit": "x/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json")])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "bench gate ok" in res.stdout
+
+
+def test_gate_fails_on_regression(tmp_path):
+    base = {"m1": {"metric": "m1", "value": 100.0, "unit": "x/s"}}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "m1", "value": 80.0, "unit": "x/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json")])
+    assert res.returncode == 1
+    assert "REGRESSION GATE FAILED" in res.stdout
+    assert "+20.0% regression" in res.stdout
+
+
+def test_gate_fails_on_missing_or_failed_row(tmp_path):
+    base = {"m1": {"metric": "m1", "value": 100.0},
+            "m2": {"metric": "m2", "value": 10.0}}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "m1_FAILED", "value": 0, "unit": "error"},
+                  {"metric": "m1", "value": 0, "unit": "error"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json")])
+    assert res.returncode == 1
+    assert "m2: missing" in res.stdout
+    assert "m1: current run FAILED" in res.stdout
+
+
+def test_gate_update_writes_baseline(tmp_path):
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "m1", "value": 50.0, "unit": "x/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "new.json"), "--update"])
+    assert res.returncode == 0
+    data = json.loads((tmp_path / "new.json").read_text())
+    assert data["m1"]["value"] == 50.0
+
+
+def test_gate_opbench_mode(tmp_path):
+    base = {"op_a": {"op": "op_a", "ms": 1.0}}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    (tmp_path / "cur.json").write_text(json.dumps(
+        {"op_a": {"op": "op_a", "ms": 2.0}}))
+    res = _run(["--opbench", str(tmp_path / "cur.json"),
+                "--baseline", str(tmp_path / "base.json")])
+    assert res.returncode == 1
+    assert "+100%" in res.stdout
+
+
+def test_repo_baseline_is_current_format():
+    """The committed BENCH_BASELINE.json gates the committed metric
+    names — a renamed bench row must update the baseline too."""
+    with open(os.path.join(REPO, "BENCH_BASELINE.json")) as f:
+        base = json.load(f)
+    for m in ("gpt_1p3b_train_tokens_per_sec_per_chip",
+              "bert_base_finetune_tokens_per_sec_per_chip",
+              "resnet50_train_images_per_sec_per_chip"):
+        assert m in base
+        assert base[m]["value"] > 0
+
+
+def test_pyproject_packaging_metadata():
+    """pip install -e . consumes this file; validate it statically
+    (no network in the test env)."""
+    import tomllib
+
+    with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    assert meta["project"]["name"] == "paddle-tpu"
+    assert "jax" in meta["project"]["dependencies"]
+    inc = meta["tool"]["setuptools"]["packages"]["find"]["include"]
+    assert "paddle_tpu*" in inc
+    from setuptools import find_packages
+
+    pkgs = find_packages(where=REPO, include=["paddle_tpu*"])
+    assert "paddle_tpu" in pkgs and "paddle_tpu.distributed" in pkgs

@@ -111,3 +111,16 @@ def test_wide_deep_trains():
     shard = model.embedding.table._shards[0]
     if shard.rows:
         assert isinstance(next(iter(shard.rows.values())), np.ndarray)
+
+
+def test_per_id_init_topology_invariant():
+    """per_id_init: the same id initializes identically under ANY shard
+    count (the portability the service tier relies on; review fix r4)."""
+    t2 = MemorySparseTable(dim=4, nshards=2, seed=7, per_id_init=True)
+    t4 = MemorySparseTable(dim=4, nshards=4, seed=7, per_id_init=True)
+    ids = np.array([0, 1, 5, 6, 123456789])
+    np.testing.assert_array_equal(t2.pull(ids), t4.pull(ids))
+    # ...and independently of materialization ORDER
+    t2b = MemorySparseTable(dim=4, nshards=2, seed=7, per_id_init=True)
+    t2b.pull(ids[::-1])
+    np.testing.assert_array_equal(t2.pull(ids), t2b.pull(ids))

@@ -1,0 +1,135 @@
+"""CI bench regression gate — analog of
+tools/check_op_benchmark_result.py + tools/ci_model_benchmark.sh: fail
+when a model bench row or an op microbench regresses beyond the
+threshold vs its stored baseline.
+
+Usage:
+    # model rows: current = file of bench.py JSON lines (or '-' stdin)
+    python tools/check_bench_result.py --bench current.jsonl \
+        --baseline BENCH_BASELINE.json [--threshold 0.10]
+    # op rows: delegates to bench_ops result files (op -> {ms})
+    python tools/check_bench_result.py --opbench current.json \
+        --baseline OPBENCH.json [--threshold 0.25]
+    # refresh the model baseline from a current run
+    python tools/check_bench_result.py --bench current.jsonl \
+        --baseline BENCH_BASELINE.json --update
+
+Model rows compare `value` (throughput: higher is better); op rows
+compare `ms` (lower is better). A metric present in the baseline but
+missing from the current run fails (a silently-skipped bench is a
+disabled gate); new metrics pass with a note (add them with --update).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_bench_lines(path):
+    text = sys.stdin.read() if path == "-" else open(path).read()
+    rows = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "metric" in rec and "value" in rec:
+            rows[rec["metric"]] = rec
+    return rows
+
+
+def check_models(current, baseline, threshold):
+    failures, notes = [], []
+    for metric, base in baseline.items():
+        if metric not in current:
+            failures.append(f"{metric}: missing from current run "
+                            "(baseline has it)")
+            continue
+        cur = current[metric]
+        if metric.endswith("_FAILED") or cur.get("unit") == "error":
+            failures.append(f"{metric}: current run FAILED")
+            continue
+        bv, cv = float(base["value"]), float(cur["value"])
+        if bv <= 0:
+            continue
+        drop = 1.0 - cv / bv
+        if drop > threshold:
+            failures.append(
+                f"{metric}: {cv:.1f} vs baseline {bv:.1f} "
+                f"({drop:+.1%} regression, threshold {threshold:.0%})")
+    for metric in sorted(set(current) - set(baseline)):
+        notes.append(f"{metric}: not in baseline (add with --update)")
+    return failures, notes
+
+
+def check_ops(current, baseline, threshold):
+    failures, notes = [], []
+    for name, base in baseline.items():
+        if name not in current:
+            failures.append(f"{name}: missing from current run")
+            continue
+        slow = current[name]["ms"] / base["ms"] - 1.0
+        if slow > threshold:
+            failures.append(
+                f"{name}: {current[name]['ms']}ms vs {base['ms']}ms "
+                f"({slow:+.0%}, threshold {threshold:.0%})")
+    for name in sorted(set(current) - set(baseline)):
+        notes.append(f"{name}: not in baseline")
+    return failures, notes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--bench", help="bench.py JSON-lines file or '-'")
+    g.add_argument("--opbench", help="bench_ops.py --save style file")
+    ap.add_argument("--baseline", required=True)
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="allowed fractional regression "
+                         "(default 0.10 model / 0.25 op)")
+    ap.add_argument("--update", action="store_true",
+                    help="write the current results as the new baseline "
+                         "instead of checking")
+    args = ap.parse_args(argv)
+
+    if args.bench:
+        current = load_bench_lines(args.bench)
+        threshold = 0.10 if args.threshold is None else args.threshold
+        if args.update:
+            with open(args.baseline, "w") as f:
+                json.dump(current, f, indent=1)
+            print(f"baseline updated: {args.baseline} "
+                  f"({len(current)} metrics)")
+            return 0
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        failures, notes = check_models(current, baseline, threshold)
+    else:
+        with open(args.opbench) as f:
+            current = json.load(f)
+        threshold = 0.25 if args.threshold is None else args.threshold
+        if args.update:
+            with open(args.baseline, "w") as f:
+                json.dump(current, f, indent=1)
+            print(f"baseline updated: {args.baseline}")
+            return 0
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        failures, notes = check_ops(current, baseline, threshold)
+
+    for n in notes:
+        print(f"note: {n}")
+    if failures:
+        print("BENCH REGRESSION GATE FAILED:\n  " + "\n  ".join(failures))
+        return 1
+    print(f"bench gate ok ({len(current)} entries, "
+          f"threshold {threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,10 @@ from .collective import (
     get_group,
     new_group,
     recv,
+    irecv,
+    isend,
+    P2POp,
+    batch_isend_irecv,
     reduce,
     reduce_scatter,
     scatter,
@@ -71,7 +75,7 @@ from .topology import (
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "all_reduce", "all_gather", "broadcast", "reduce", "scatter", "alltoall",
-    "reduce_scatter", "send", "recv", "barrier", "new_group", "get_group",
+    "reduce_scatter", "send", "recv", "isend", "irecv", "P2POp", "batch_isend_irecv", "barrier", "new_group", "get_group",
     "ReduceOp", "Group", "functional", "CommunicateTopology",
     "HybridCommunicateGroup", "get_hybrid_communicate_group",
     "set_hybrid_communicate_group", "ProcessMesh", "shard_tensor",

@@ -328,14 +328,126 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
     return _ret(tensor, out[me])
 
 
+# -- host p2p over rpc -------------------------------------------------------
+# Device-to-device p2p inside a compiled program stays pipeline-internal
+# (distributed.pipeline shift registers — XLA collective-permute on ICI).
+# THIS surface is the eager host-level send/recv of the reference
+# (communication/send.py, recv.py, batch_isend_irecv.py over NCCL p2p):
+# payloads travel over the rpc transport and land in a per-process
+# mailbox keyed (src, tag); recv blocks until the matching message
+# arrives. Requires paddle.distributed.rpc.init_rpc() (the launcher's
+# trainer world) — the PS service tier shares the same rpc world.
+
+import threading as _threading
+
+_P2P_BOX: dict = {}
+_P2P_LOCK = _threading.Condition()
+
+
+def _p2p_state():
+    return _P2P_BOX, _P2P_LOCK
+
+
+def _p2p_deliver(src, tag, payload):
+    box, lock = _p2p_state()
+    with lock:
+        box.setdefault((src, tag), []).append(payload)
+        lock.notify_all()
+    return True
+
+
+def _rpc_peer_name(rank):
+    from paddle_tpu.distributed import rpc
+
+    # trainer names follow the PS-service convention; fall back to the
+    # registered name at that rank for custom rpc worlds
+    for w in rpc.get_all_worker_infos():
+        if w.rank == rank:
+            return w.name
+    raise ValueError(f"no rpc worker at rank {rank}")
+
+
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError("p2p send is a pipeline-internal op on TPU; "
-                              "use distributed.pipeline")
+    """Host p2p send (communication/send.py analog). Blocks until the
+    payload is delivered into dst's mailbox (rpc round-trip). Ranks are
+    RPC-world ranks (recv matches on the same), so p2p works in rpc
+    worlds that never called init_parallel_env."""
+    import numpy as np
+
+    from paddle_tpu.distributed import rpc
+
+    me = rpc.get_worker_info().rank
+    arr = np.asarray(tensor._array if isinstance(tensor, Tensor)
+                     else tensor)
+    rpc.rpc_sync(_rpc_peer_name(dst), _p2p_deliver,
+                 args=(me, 0, arr))
+    return tensor
 
 
-def recv(tensor: Tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError("p2p recv is a pipeline-internal op on TPU; "
-                              "use distributed.pipeline")
+def recv(tensor: Tensor, src=0, group=None, sync_op=True, timeout=300):
+    """Host p2p recv: blocks until a message from `src` arrives, then
+    writes it into `tensor` (in-place, reference semantics)."""
+    box, lock = _p2p_state()
+    with lock:
+        ok = lock.wait_for(lambda: box.get((src, 0)), timeout=timeout)
+        if not ok:
+            raise TimeoutError(f"recv from rank {src}: no message "
+                               f"within {timeout}s")
+        payload = box[(src, 0)].pop(0)
+        if not box[(src, 0)]:
+            del box[(src, 0)]
+    tensor.set_value(jnp.asarray(payload).astype(tensor._array.dtype))
+    return tensor
+
+
+class P2POp:
+    """paddle.distributed.P2POp analog for batch_isend_irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be isend or irecv")
+        self.op = isend if op in (isend, send) else irecv
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+class _P2PTask:
+    def __init__(self, fn):
+        import threading
+
+        self._err = None
+
+        def run():
+            try:
+                fn()
+            except Exception as e:  # surfaced on wait()
+                self._err = e
+
+        self._t = threading.Thread(target=run, daemon=True)
+        self._t.start()
+
+    def wait(self, timeout=300):
+        self._t.join(timeout)
+        if self._t.is_alive():
+            raise TimeoutError(
+                f"p2p op still pending after {timeout}s")
+        if self._err is not None:
+            raise self._err
+
+
+def isend(tensor: Tensor, dst=0, group=None):
+    return _P2PTask(lambda: send(tensor, dst, group))
+
+
+def irecv(tensor: Tensor, src=0, group=None):
+    return _P2PTask(lambda: recv(tensor, src, group))
+
+
+def batch_isend_irecv(p2p_op_list):
+    """communication/batch_isend_irecv.py analog: launch every op,
+    return the task list (caller waits each)."""
+    return [op.op(op.tensor, op.peer, op.group) for op in p2p_op_list]
 
 
 def barrier(group=None):

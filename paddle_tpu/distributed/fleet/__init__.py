@@ -33,16 +33,45 @@ _fleet_state = {"initialized": False, "strategy": None}
 
 def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStrategy] = None):
     """Analog of fleet.init (fleet.py:169): builds the hybrid topology
-    from strategy.hybrid_configs and installs the global mesh."""
+    from strategy.hybrid_configs and installs the global mesh. The
+    degree product is validated against the visible device count HERE
+    so a wrong hybrid_configs fails with the reference-style topology
+    error instead of an opaque mesh error at first compile."""
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
+    known = {"dp_degree", "mp_degree", "pp_degree", "sharding_degree",
+             "cp_degree", "ep_degree"}
+    unknown = set(hc) - known
+    if unknown:
+        raise ValueError(
+            f"hybrid_configs has unknown keys {sorted(unknown)}; "
+            f"valid: {sorted(known)}")
+    degrees = {k: int(hc.get(k, 1)) for k in known}
+    bad = {k: v for k, v in degrees.items() if v < 1}
+    if bad:
+        raise ValueError(f"hybrid_configs degrees must be >= 1: {bad}")
+    import math
+
+    import jax
+
+    need = math.prod(degrees.values())
+    ndev = len(jax.devices())
+    if need > ndev:
+        asked = " x ".join(f"{k.split('_')[0]}={v}"
+                           for k, v in sorted(degrees.items())
+                           if v > 1) or "1"
+        raise ValueError(
+            f"hybrid_configs asks for {asked} = {need} devices, but "
+            f"only {ndev} are visible — fix the degrees or the launch "
+            "size (the reference raises the same way when nranks != "
+            "degree product, topology.py CommunicateTopology)")
     hcg = HybridCommunicateGroup(
-        dp=hc.get("dp_degree", 1),
-        mp=hc.get("mp_degree", 1),
-        pp=hc.get("pp_degree", 1),
-        sharding=hc.get("sharding_degree", 1),
-        cp=hc.get("cp_degree", 1),
-        ep=hc.get("ep_degree", 1),
+        dp=degrees["dp_degree"],
+        mp=degrees["mp_degree"],
+        pp=degrees["pp_degree"],
+        sharding=degrees["sharding_degree"],
+        cp=degrees["cp_degree"],
+        ep=degrees["ep_degree"],
     )
     set_hybrid_communicate_group(hcg)
     _fleet_state["initialized"] = True

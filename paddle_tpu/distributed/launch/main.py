@@ -86,6 +86,13 @@ def build_parser():
     return p
 
 
+def _normalize_topology(args):
+    """--nnodes N without --nprocs-per-node keeps its pre-r4 meaning of
+    N ranks (one per simulated node) instead of being silently ignored."""
+    if args.nnodes > 1 and not args.nprocs_per_node:
+        args.nprocs_per_node = 1
+
+
 def _world_size(args) -> int:
     if args.nprocs_per_node:
         return args.nnodes * args.nprocs_per_node
@@ -124,6 +131,7 @@ def _stream(proc, label):
 
 def launch(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    _normalize_topology(args)
     if args.master:
         master, probe = args.master, None
     else:

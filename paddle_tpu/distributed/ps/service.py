@@ -106,17 +106,29 @@ _STOP_LOCK = threading.Lock()
 _RULES = {"sgd": SparseSGDRule, "adagrad": SparseAdagradRule}
 
 
+_TABLE_SPECS: dict = {}
+
+
 def _srv_ensure_table(name, dim, rule_kind, rule_kwargs, seed):
     """Idempotent table creation (every trainer configures every
     server; first call wins — guarded: concurrent ensure RPCs from two
-    trainers must not each create and clobber the other's table)."""
+    trainers must not each create and clobber the other's table). A
+    CONFLICTING re-ensure (different dim/rule/seed) fails here, at the
+    misconfiguration, not later as a shape error in pull()."""
+    spec = (dim, rule_kind, tuple(sorted(rule_kwargs.items())), seed)
     with _CREATE_LOCK:
-        if name not in _TABLES:
-            rule = _RULES[rule_kind](**rule_kwargs)
-            _TABLE_LOCKS[name] = threading.Lock()
-            _TABLES[name] = MemorySparseTable(
-                dim, rule=rule, nshards=1, seed=seed, name=name,
-                per_id_init=True)
+        if name in _TABLES:
+            if _TABLE_SPECS[name] != spec:
+                raise ValueError(
+                    f"table {name!r} already exists with spec "
+                    f"{_TABLE_SPECS[name]}, conflicting with {spec}")
+            return True
+        rule = _RULES[rule_kind](**rule_kwargs)
+        _TABLE_LOCKS[name] = threading.Lock()
+        _TABLES[name] = MemorySparseTable(
+            dim, rule=rule, nshards=1, seed=seed, name=name,
+            per_id_init=True)
+        _TABLE_SPECS[name] = spec
     return True
 
 
@@ -385,8 +397,10 @@ class Communicator:
             raise self._err
 
     def stop(self):
+        # flush FIRST in every mode: geo deltas accumulated since the
+        # last k-step boundary must ship, thread or no thread
+        self.flush()
         if self._thread is not None:
-            self.flush()
             self._queue.put(None)
             self._thread.join(timeout=10)
             self._thread = None

@@ -1084,7 +1084,7 @@ def _triple(v):
 
 
 def _pool3d_fn(kernel_size, stride, padding, init, op, norm=False,
-               count_include_pad=True):
+               count_include_pad=True, divisor_override=None):
     ks = _triple(kernel_size)
     st = _triple(stride if stride is not None else kernel_size)
     pd = _triple(padding)
@@ -1095,7 +1095,9 @@ def _pool3d_fn(kernel_size, stride, padding, init, op, norm=False,
         strides = (1, 1) + st
         out = jax.lax.reduce_window(a, init, op, window, strides,
                                     padding=pad_cfg)
-        if norm:
+        if divisor_override is not None:
+            out = out / float(divisor_override)
+        elif norm:
             cnt = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
                                         window, strides, padding=pad_cfg)
             out = out / cnt
@@ -1131,12 +1133,13 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW"):
     _check_pool3d_args(ceil_mode, data_format)
-    if divisor_override is not None:
-        raise NotImplementedError("pool3d: divisor_override not supported")
+    if divisor_override is not None and divisor_override <= 0:
+        raise ValueError("divisor_override must be positive")
     x = as_tensor(x)
     return apply("avg_pool3d",
                  _pool3d_fn(kernel_size, stride, padding, 0.0, jax.lax.add,
-                            norm=exclusive), x)
+                            norm=exclusive,
+                            divisor_override=divisor_override), x)
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):

@@ -298,12 +298,21 @@ def is_same_shape(x, y):
 
 def _dense_to_sparse_coo(self, sparse_dim):
     """Tensor.to_sparse_coo (dense→sparse is data-dependent, so this is
-    an eager-only conversion — index discovery happens on host)."""
+    an eager-only conversion — index discovery happens on host).
+    sparse_dim < ndim builds a hybrid COO: indices over the leading
+    sparse_dim dims, dense trailing dims ride in the values (a leading
+    position is nonzero iff ANY trailing element is)."""
     a = np.asarray(self._array)
-    if sparse_dim != a.ndim:
-        raise NotImplementedError(
-            "only sparse_dim == ndim (fully sparse) is supported")
-    nz = np.nonzero(a)
+    if not 1 <= sparse_dim <= a.ndim:
+        raise ValueError(f"sparse_dim={sparse_dim} for ndim={a.ndim}")
+    if sparse_dim == a.ndim:
+        nz = np.nonzero(a)
+        idx = jnp.asarray(np.stack(nz), jnp.int32)
+        vals = Tensor._wrap(
+            self._array[tuple(jnp.asarray(n) for n in nz)])
+        return SparseCooTensor(idx, vals, list(a.shape), coalesced=True)
+    mask = (a != 0).any(axis=tuple(range(sparse_dim, a.ndim)))
+    nz = np.nonzero(mask)
     idx = jnp.asarray(np.stack(nz), jnp.int32)
     vals = Tensor._wrap(self._array[tuple(jnp.asarray(n) for n in nz)])
     return SparseCooTensor(idx, vals, list(a.shape), coalesced=True)

@@ -343,6 +343,39 @@ def run_rpc(dist, paddle, rank, world):
     print("ok rpc", flush=True)
 
 
+def run_p2p(dist, paddle, rank, world):
+    """Host p2p send/recv + batch_isend_irecv over the rpc transport
+    (communication/send.py, batch_isend_irecv.py analogs)."""
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc(f"worker{rank}")
+    # blocking pair: 0 -> 1
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(4, dtype=np.float32) + 10),
+                  dst=1)
+    elif rank == 1:
+        buf = paddle.to_tensor(np.zeros(4, np.float32))
+        dist.recv(buf, src=0)
+        np.testing.assert_allclose(np.asarray(buf._array),
+                                   [10, 11, 12, 13])
+    dist.barrier()
+    # batched bidirectional exchange (the ring-exchange shape)
+    peer = (rank + 1) % world
+    out = paddle.to_tensor(np.full((3,), float(rank), np.float32))
+    buf = paddle.to_tensor(np.zeros(3, np.float32))
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.isend, out, peer),
+        dist.P2POp(dist.irecv, buf, (rank - 1) % world),
+    ])
+    for t in tasks:
+        t.wait()
+    np.testing.assert_allclose(np.asarray(buf._array),
+                               np.full((3,), float((rank - 1) % world)))
+    dist.barrier()
+    rpc.shutdown()
+    print("ok p2p", flush=True)
+
+
 def main():
     phase = sys.argv[1] if len(sys.argv) > 1 else "all"
     out_file = sys.argv[2] if len(sys.argv) > 2 else None
@@ -383,6 +416,8 @@ def main():
     if phase in ("all", "localsgd"):
         run_localsgd(dist, paddle, rank, world,
                      out_file if phase == "localsgd" else None)
+    if phase == "p2p":
+        run_p2p(dist, paddle, rank, world)
     if phase == "twonode":
         # two-node localhost simulation: check the node/local env split
         # is consistent with the global rank, then run a collective

@@ -303,3 +303,25 @@ def test_shared_sublayer_no_double_donation():
     l0 = float(step(x, y))
     l1 = float(step(x, y))
     assert np.isfinite(l0) and np.isfinite(l1)
+
+
+def test_avg_pool3d_divisor_override():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.ones((1, 1, 4, 4, 4), np.float32))
+    out = F.avg_pool3d(x, kernel_size=2, stride=2, divisor_override=4)
+    # window sum is 8 ones; / 4 override = 2
+    np.testing.assert_allclose(np.asarray(out._array),
+                               np.full((1, 1, 2, 2, 2), 2.0))
+
+
+def test_hybrid_coo_partial_sparse_dim():
+    a = np.zeros((3, 2), np.float32)
+    a[1] = [5.0, 0.0]
+    t = paddle.to_tensor(a)
+    sp = t.to_sparse_coo(1)  # hybrid: 1 sparse dim, 1 dense dim
+    assert sp.nnz() == 1
+    np.testing.assert_array_equal(np.asarray(sp.indices()._array), [[1]])
+    np.testing.assert_array_equal(np.asarray(sp.values()._array),
+                                  [[5.0, 0.0]])
+    np.testing.assert_allclose(np.asarray(sp.to_dense()._array), a)

@@ -19,7 +19,8 @@ def test_timeit_measures_real_work():
 
     f = jax.jit(lambda a: jnp.tanh(a @ a.T).sum()[None])
     x = jnp.ones((256, 256), jnp.float32)
-    ms = bench_ops._timeit(f, x, n_small=2, n_big=6)
+    ms = bench_ops._timeit(f, x, n_small=2, target_s=0.05,
+                           n_cap=64)
     assert 0 < ms < 1000
 
 

@@ -120,7 +120,13 @@ def bench_gpt(on_tpu):
 # Measured ceilings on the bench chip (v5e via the axon tunnel), for
 # reading the numbers below in context:
 # - Large-matmul FLOPs (GPT ffn shapes) sustain ~118 TF/s inside the
-#   full compiled train step (mfu 0.60 on the flagship).
+#   full compiled train step. The flagship's decoder attention was the
+#   next-largest term (~110ms of the r3 305ms step; the tuned library
+#   flash kernel runs 22.5 TF/s causal-useful at B2 H16 S2048 D128);
+#   the chunked causal kernel (flash_attention.py
+#   chunked_causal_attention: whole head per program, static prefix-k
+#   blocks, exact softmax, single-pass bwd) runs 1.74x faster and took
+#   the row from 0.61 to 0.66 MFU in r4.
 # - BERT-base e2e was attention-bound in r3 (0.36 mfu): at S512/D64 the
 #   library flash kernel runs 8.9 ms/layer fwd+bwd (768 tiny programs,
 #   twice-recomputing backward). The fused short-seq kernel

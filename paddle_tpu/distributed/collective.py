@@ -348,6 +348,13 @@ def _p2p_state():
     return _P2P_BOX, _P2P_LOCK
 
 
+def _p2p_reset():
+    """Drop undelivered payloads — called by rpc.init_rpc/shutdown so a
+    new rpc world can't consume a stale message from the previous one."""
+    with _P2P_LOCK:
+        _P2P_BOX.clear()
+
+
 def _p2p_deliver(src, tag, payload):
     box, lock = _p2p_state()
     with lock:
@@ -359,19 +366,20 @@ def _p2p_deliver(src, tag, payload):
 def _rpc_peer_name(rank):
     from paddle_tpu.distributed import rpc
 
-    # trainer names follow the PS-service convention; fall back to the
-    # registered name at that rank for custom rpc worlds
-    for w in rpc.get_all_worker_infos():
-        if w.rank == rank:
-            return w.name
-    raise ValueError(f"no rpc worker at rank {rank}")
+    w = rpc.get_worker_info_by_rank(rank)
+    if w is None:
+        raise ValueError(f"no rpc worker at rank {rank}")
+    return w.name
 
 
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
-    """Host p2p send (communication/send.py analog). Blocks until the
-    payload is delivered into dst's mailbox (rpc round-trip). Ranks are
+    """Host p2p send (communication/send.py analog). Ranks are
     RPC-world ranks (recv matches on the same), so p2p works in rpc
-    worlds that never called init_parallel_env."""
+    worlds that never called init_parallel_env. sync_op=False returns a
+    waitable task (reference task semantics) instead of blocking on the
+    rpc round-trip."""
+    if not sync_op:
+        return _P2PTask(lambda: send(tensor, dst, group, True))
     import numpy as np
 
     from paddle_tpu.distributed import rpc
@@ -386,7 +394,10 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True, timeout=300):
     """Host p2p recv: blocks until a message from `src` arrives, then
-    writes it into `tensor` (in-place, reference semantics)."""
+    writes it into `tensor` (in-place, reference semantics).
+    sync_op=False returns a waitable task."""
+    if not sync_op:
+        return _P2PTask(lambda: recv(tensor, src, group, True, timeout))
     box, lock = _p2p_state()
     with lock:
         ok = lock.wait_for(lambda: box.get((src, 0)), timeout=timeout)
@@ -405,7 +416,8 @@ class P2POp:
 
     def __init__(self, op, tensor, peer, group=None):
         if op not in (isend, irecv, send, recv):
-            raise ValueError("P2POp op must be isend or irecv")
+            raise ValueError(
+                "P2POp op must be one of isend/irecv/send/recv")
         self.op = isend if op in (isend, send) else irecv
         self.tensor = tensor
         self.peer = peer
@@ -414,8 +426,6 @@ class P2POp:
 
 class _P2PTask:
     def __init__(self, fn):
-        import threading
-
         self._err = None
 
         def run():
@@ -424,7 +434,7 @@ class _P2PTask:
             except Exception as e:  # surfaced on wait()
                 self._err = e
 
-        self._t = threading.Thread(target=run, daemon=True)
+        self._t = _threading.Thread(target=run, daemon=True)
         self._t.start()
 
     def wait(self, timeout=300):

@@ -39,14 +39,21 @@ def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStra
     error instead of an opaque mesh error at first compile."""
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
-    known = {"dp_degree", "mp_degree", "pp_degree", "sharding_degree",
-             "cp_degree", "ep_degree"}
-    unknown = set(hc) - known
+    degree_keys = {"dp_degree", "mp_degree", "pp_degree",
+                   "sharding_degree", "cp_degree", "ep_degree"}
+    # non-degree keys the reference accepts ride along untouched
+    # ("order", nested "*_configs" blocks); anything else is probably a
+    # typo'd degree — warn, don't break reference-style configs
+    passthrough = {"order", "dp_configs", "mp_configs", "pp_configs",
+                   "sharding_configs", "cp_configs", "ep_configs"}
+    unknown = set(hc) - degree_keys - passthrough
     if unknown:
-        raise ValueError(
-            f"hybrid_configs has unknown keys {sorted(unknown)}; "
-            f"valid: {sorted(known)}")
-    degrees = {k: int(hc.get(k, 1)) for k in known}
+        import warnings
+
+        warnings.warn(
+            f"hybrid_configs keys {sorted(unknown)} are not understood "
+            f"and will be ignored (degrees: {sorted(degree_keys)})")
+    degrees = {k: int(hc.get(k, 1)) for k in degree_keys}
     bad = {k: v for k, v in degrees.items() if v < 1}
     if bad:
         raise ValueError(f"hybrid_configs degrees must be >= 1: {bad}")

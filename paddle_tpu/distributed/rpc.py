@@ -157,7 +157,19 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         else:
             raise TimeoutError(f"rpc master {master_endpoint} unreachable")
     _state["workers"] = {w.name: w for w in workers}
+    _state["by_rank"] = {w.rank: w for w in workers}
+    _p2p_mailbox_reset()
     return me
+
+
+def _p2p_mailbox_reset():
+    """A fresh rpc world must not see leftover p2p payloads."""
+    try:
+        from paddle_tpu.distributed.collective import _p2p_reset
+
+        _p2p_reset()
+    except Exception:
+        pass
 
 
 def get_worker_info(name=None):
@@ -165,6 +177,11 @@ def get_worker_info(name=None):
     if name is None:
         return ws[_state["name"]]
     return ws[name]
+
+
+def get_worker_info_by_rank(rank):
+    """O(1) rank lookup (send/recv address peers by rank)."""
+    return _state.get("by_rank", {}).get(rank)
 
 
 def get_all_worker_infos():
@@ -203,3 +220,4 @@ def shutdown():
     if pool is not None:
         pool.shutdown(wait=False)
     _state.clear()
+    _p2p_mailbox_reset()

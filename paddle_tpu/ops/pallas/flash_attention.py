@@ -345,6 +345,199 @@ def _shapes_ok_for_shortseq(Sq, Skv, D):
 
 
 # ---------------------------------------------------------------------------
+# chunked exact-softmax CAUSAL kernel (decoder shapes)
+# ---------------------------------------------------------------------------
+# The library flash kernel pays twice at decoder shapes: online-softmax
+# rescaling in the forward, and a two-kernel backward that recomputes
+# scores twice (9 GEMM-equivalents). This kernel processes one (b,h)
+# whole per program with an UNROLLED q-block loop whose k-prefix slices
+# are static — causal FLOP-optimal (no above-diagonal blocks), exact
+# softmax per row (the whole prefix row is in VMEM, no rescaling), and
+# a single-pass backward that accumulates dk/dv in VMEM scratch across
+# q-blocks (5 GEMMs + one recompute). Measured at the GPT flagship
+# shape (B2 H16 S2048 D128 causal, v5e): 2.64 ms/layer fwd+bwd vs 4.59
+# ms for the tuned library kernel — 1.74x, worth ~45 ms/step on the
+# 1.3B bench.
+
+
+def _causal_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                       bq):
+    S = q_ref.shape[1]
+    for qi in range(S // bq):
+        lo, hi = qi * bq, (qi + 1) * bq
+        q = q_ref[0, lo:hi]          # [bq, D]
+        k = k_ref[0, :hi]            # [kw, D] — causal prefix only
+        v = v_ref[0, :hi]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, hi), 0) + lo
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, hi), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        o = jax.lax.dot_general(p.astype(v.dtype), v,
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        o_ref[0, lo:hi] = (o / l).astype(o_ref.dtype)
+        lse_ref[0, :, lo:hi] = jnp.broadcast_to(
+            (m + jnp.log(l))[:, 0][None, :], (8, bq))
+
+
+def _causal_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                       dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                       scale, bq):
+    S = q_ref.shape[1]
+    dk_acc[...] = jnp.zeros_like(dk_acc)
+    dv_acc[...] = jnp.zeros_like(dv_acc)
+    for qi in range(S // bq):
+        lo, hi = qi * bq, (qi + 1) * bq
+        q = q_ref[0, lo:hi]
+        do = do_ref[0, lo:hi]
+        o = o_ref[0, lo:hi]
+        k = k_ref[0, :hi]
+        v = v_ref[0, :hi]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, hi), 0) + lo
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, hi), 1)
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0, lo:hi][:, None])
+        pb = p.astype(v.dtype)
+        dv_acc[:hi] += jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
+        dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dq_ref[0, lo:hi] = dq.astype(dq_ref.dtype)
+        dk_acc[:hi] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _causal_bq(S, D, itemsize=2):
+    """q-block size: largest divisor of S whose live score
+    intermediates stay near 10MB. Per-element estimate: s/p f32 plus
+    pb/ds at the INPUT precision (10B/elem for bf16 — verified at the
+    GPT shape — 16B for f32). 0 = no viable block."""
+    per_elem = 10 if itemsize <= 2 else 16
+    for bq in (512, 256, 128):
+        if S % bq == 0 and per_elem * bq * S <= 11 * 1024 * 1024:
+            return bq
+    return 0
+
+
+def _shapes_ok_for_causal(Sq, Skv, D, itemsize=2):
+    bq = _causal_bq(Sq, D, itemsize)
+    if not (Sq == Skv and D in (64, 128) and bq):
+        return False
+    if Sq // bq > 16:  # unroll depth (compile time) bound
+        return False
+    # whole-head residents: k+v (itemsize) + dk/dv f32 accumulators,
+    # plus the live per-q-block intermediates. 14MB leaves headroom in
+    # the ~16MB/core VMEM (the GPT shape lands at 13MB, verified)
+    resident = 2 * Sq * D * itemsize + 2 * Sq * D * 4
+    return resident + 10 * bq * Sq <= 14 * 1024 * 1024
+
+
+def _causal_call_fwd(q, k, v, scale, bq, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+
+    def blk():
+        return pl.BlockSpec((1, S, D), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_causal_fwd_kernel, scale=scale, bq=bq),
+        grid=(BH,),
+        interpret=interpret,
+        in_specs=[blk(), blk(), blk()],
+        out_specs=[blk(),
+                   pl.BlockSpec((1, 8, S), lambda i: (i, 0, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, 8, S), jnp.float32)],
+    )(q, k, v)
+
+
+def _causal_call_bwd(q, k, v, o, do, lse, scale, bq, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, S, D = q.shape
+
+    def blk():
+        return pl.BlockSpec((1, S, D), lambda i: (i, 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    return pl.pallas_call(
+        functools.partial(_causal_bwd_kernel, scale=scale, bq=bq),
+        grid=(BH,),
+        interpret=interpret,
+        in_specs=[blk(), blk(), blk(), blk(), blk(),
+                  pl.BlockSpec((1, 8, S), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[blk(), blk(), blk()],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)] * 3,
+        scratch_shapes=[pltpu.VMEM((S, D), jnp.float32),
+                        pltpu.VMEM((S, D), jnp.float32)],
+    )(q, k, v, o, do, lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _causal_attention(q, k, v, scale, interpret):
+    o, _ = _causal_call_fwd(q, k, v, scale,
+                            _causal_bq(q.shape[1], q.shape[2],
+                                       q.dtype.itemsize),
+                            interpret=interpret)
+    return o
+
+
+def _causal_vjp_fwd(q, k, v, scale, interpret):
+    o, lse = _causal_call_fwd(q, k, v, scale,
+                              _causal_bq(q.shape[1], q.shape[2],
+                                         q.dtype.itemsize),
+                              interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _causal_vjp_bwd(scale, interpret, res, do):
+    q, k, v, o, lse = res
+    return _causal_call_bwd(q, k, v, o, do, lse, scale,
+                            _causal_bq(q.shape[1], q.shape[2],
+                                       q.dtype.itemsize),
+                            interpret=interpret)
+
+
+_causal_attention.defvjp(_causal_vjp_fwd, _causal_vjp_bwd)
+
+
+def chunked_causal_attention(q, k, v, scale=None, interpret=False):
+    """Fused causal attention, [B,S,H,D] -> [B,S,H,D]. Requirements:
+    _shapes_ok_for_causal. Used by flash_attention for decoder
+    self-attention shapes."""
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    def to_bh(x):
+        return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+    out = _causal_attention(to_bh(q), to_bh(k), to_bh(v), scale,
+                            interpret)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
+
+
+# ---------------------------------------------------------------------------
 # production path: jax's tuned TPU flash attention (fwd+bwd), XLA fallback
 # ---------------------------------------------------------------------------
 
@@ -426,6 +619,21 @@ def flash_attention(q, k, v, causal: bool = True, scale=None):
                 warnings.warn(
                     f"shortseq_attention unavailable, trying library "
                     f"flash attention: {type(e).__name__}: {e}")
+                _fallback_warned = True
+    if _on_tpu() and causal and \
+            _shapes_ok_for_causal(Sq, Skv, D, q.dtype.itemsize):
+        # decoder self-attention: the chunked causal kernel (see above)
+        try:
+            out = chunked_causal_attention(q, k, v, scale=scale)
+            PATH_STATS["pallas"] += 1
+            return out
+        except Exception as e:  # noqa: BLE001 — fall through, loudly
+            if not _fallback_warned:
+                import warnings
+
+                warnings.warn(
+                    f"chunked_causal_attention unavailable, trying "
+                    f"library flash attention: {type(e).__name__}: {e}")
                 _fallback_warned = True
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)

@@ -422,10 +422,25 @@ def test_fleet_init_validates_hybrid_configs():
     with pytest.raises(ValueError, match="128 devices"):
         fleet.init(is_collective=True, strategy=s)
 
+    # unknown keys warn (reference-style extras like "order"/"mp_configs"
+    # pass silently; a typo'd degree is ignored with a warning)
+    import warnings as _warnings
+
     s2 = DistributedStrategy()
     s2.hybrid_configs = {"dp_degree": 2, "np_degree": 3}
-    with pytest.raises(ValueError, match="unknown keys"):
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
         fleet.init(is_collective=True, strategy=s2)
+    assert any("np_degree" in str(x.message) for x in w)
+
+    s2b = DistributedStrategy()
+    s2b.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                          "order": ["dp", "pp", "sharding", "mp"],
+                          "mp_configs": {"sync_param": False}}
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        fleet.init(is_collective=True, strategy=s2b)
+    assert not w  # reference-style keys are accepted silently
 
     s3 = DistributedStrategy()
     s3.hybrid_configs = {"dp_degree": 0}

@@ -203,3 +203,59 @@ def test_shortseq_hb_divisor():
     assert _shortseq_hb(7) == 1
     for bh in (2, 3, 4, 6, 12, 768):
         assert bh % _shortseq_hb(bh) == 0
+
+
+def test_chunked_causal_attention_interpret_fwd_and_grad():
+    """The chunked causal decoder kernel (whole head per program,
+    prefix-k blocks, single-pass bwd) must match dense causal attention
+    in value and gradient — interpret mode runs the kernel on CPU."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    rng = np.random.RandomState(0)
+    B, S, H, D = 1, 512, 2, 64
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+
+    out = fa.chunked_causal_attention(q, k, v, interpret=True)
+    ref = _dense_ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-3)
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(
+            fa.chunked_causal_attention(q, k, v, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+        logits = jnp.where(jnp.tril(jnp.ones((S, S), bool)), logits,
+                           -1e30)
+        p = jax.nn.softmax(logits, -1)
+        o = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+        return jnp.sum(o ** 2)
+
+    gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, err_msg=f"d{name}")
+
+
+def test_causal_shape_gate():
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _causal_bq, _shapes_ok_for_causal)
+
+    assert _shapes_ok_for_causal(2048, 2048, 128)   # the GPT shape
+    assert _shapes_ok_for_causal(512, 512, 64)
+    assert not _shapes_ok_for_causal(2048, 1024, 128)  # cross-attn
+    assert not _shapes_ok_for_causal(2048, 2048, 96)   # odd head dim
+    assert not _shapes_ok_for_causal(16384, 16384, 128)  # VMEM blowout
+    for S in (512, 1024, 2048, 4096):
+        bq = _causal_bq(S, 128)
+        assert bq and S % bq == 0 and bq >= 128
+        assert 10 * bq * S <= 11 * 1024 * 1024

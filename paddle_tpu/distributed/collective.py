@@ -379,7 +379,7 @@ def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     waitable task (reference task semantics) instead of blocking on the
     rpc round-trip."""
     if not sync_op:
-        return _P2PTask(lambda: send(tensor, dst, group, True))
+        return isend(tensor, dst, group)
     import numpy as np
 
     from paddle_tpu.distributed import rpc
@@ -397,7 +397,7 @@ def recv(tensor: Tensor, src=0, group=None, sync_op=True, timeout=300):
     writes it into `tensor` (in-place, reference semantics).
     sync_op=False returns a waitable task."""
     if not sync_op:
-        return _P2PTask(lambda: recv(tensor, src, group, True, timeout))
+        return irecv(tensor, src, group, timeout=timeout)
     box, lock = _p2p_state()
     with lock:
         ok = lock.wait_for(lambda: box.get((src, 0)), timeout=timeout)
@@ -450,8 +450,8 @@ def isend(tensor: Tensor, dst=0, group=None):
     return _P2PTask(lambda: send(tensor, dst, group))
 
 
-def irecv(tensor: Tensor, src=0, group=None):
-    return _P2PTask(lambda: recv(tensor, src, group))
+def irecv(tensor: Tensor, src=0, group=None, timeout=300):
+    return _P2PTask(lambda: recv(tensor, src, group, True, timeout))
 
 
 def batch_isend_irecv(p2p_op_list):

@@ -18,8 +18,8 @@ import numpy as np
 import paddle_tpu.nn as nn
 
 __all__ = ["calculate_density", "create_mask", "check_mask_1d",
-           "prune_model", "decorate", "set_excluded_layers",
-           "reset_excluded_layers"]
+           "create_mask_2d_greedy", "check_mask_2d", "prune_model",
+           "decorate", "set_excluded_layers", "reset_excluded_layers"]
 
 
 def calculate_density(mat) -> float:
@@ -43,6 +43,98 @@ def create_mask(weight, n=2, m=4) -> np.ndarray:
     mask = np.ones_like(w, np.float32)
     mask[:main] = mask_main.reshape(main, *w.shape[1:])
     return mask
+
+
+def create_mask_2d_greedy(weight, n=2, m=4) -> np.ndarray:
+    """2-D n:m mask (asp mask_2d_greedy analog): within every m x m
+    block, keep entries so that EVERY row and EVERY column of the block
+    has at most n survivors, chosen greedily by |w| descending. Blocks
+    beyond a non-divisible edge stay dense."""
+    w = np.asarray(weight, np.float32)
+    if w.ndim != 2 or w.shape[0] < m or w.shape[1] < m:
+        return np.ones_like(w, np.float32)
+    R = (w.shape[0] // m) * m
+    C = (w.shape[1] // m) * m
+    mask = np.ones_like(w, np.float32)
+    blk = np.abs(w[:R, :C]).reshape(R // m, m, C // m, m) \
+        .transpose(0, 2, 1, 3).reshape(-1, m, m)
+    Nb = blk.shape[0]
+    patterns = _block_patterns_2d(n, m)
+    if patterns is not None:
+        # EXACT for small m: every valid keep-pattern (row sums == col
+        # sums == n; 90 patterns at 2:4) scored for all blocks in one
+        # matmul — both faster and denser-optimal than per-pick greedy
+        # (~16% of random blocks dead-end a sequential greedy)
+        scores = blk.reshape(Nb, -1) @ patterns.reshape(
+            patterns.shape[0], -1).T                       # [Nb, P]
+        keep = patterns[np.argmax(scores, axis=1)]
+    else:
+        # larger m: vectorized greedy (caps hold; possibly sparser)
+        order = np.argsort(blk.reshape(Nb, -1), axis=1)[:, ::-1]
+        rows = np.zeros((Nb, m), np.int64)
+        cols = np.zeros((Nb, m), np.int64)
+        keep = np.zeros((Nb, m, m), np.float32)
+        taken = np.zeros(Nb, np.int64)
+        bidx = np.arange(Nb)
+        for pos in range(m * m):
+            i, j = np.divmod(order[:, pos], m)
+            ok = (rows[bidx, i] < n) & (cols[bidx, j] < n) & \
+                (taken < n * m)
+            rows[bidx[ok], i[ok]] += 1
+            cols[bidx[ok], j[ok]] += 1
+            keep[bidx[ok], i[ok], j[ok]] = 1.0
+            taken[ok] += 1
+    mask[:R, :C] = keep.reshape(R // m, C // m, m, m) \
+        .transpose(0, 2, 1, 3).reshape(R, C)
+    return mask
+
+
+_PATTERN_CACHE: dict = {}
+
+
+def _block_patterns_2d(n, m):
+    """All m x m 0/1 matrices with every row and column summing to n
+    (None when the enumeration would be too large). 2:4 -> 90."""
+    import itertools
+
+    key = (n, m)
+    if key in _PATTERN_CACHE:
+        return _PATTERN_CACHE[key]
+    from math import comb
+
+    if comb(m, n) ** m > 500_000:
+        _PATTERN_CACHE[key] = None
+        return None
+    col_sets = list(itertools.combinations(range(m), n))
+    out = []
+    for combo in itertools.product(col_sets, repeat=m):
+        counts = [0] * m
+        for rc in combo:
+            for j in rc:
+                counts[j] += 1
+        if all(c == n for c in counts):
+            p = np.zeros((m, m), np.float32)
+            for i, rc in enumerate(combo):
+                p[i, list(rc)] = 1.0
+            out.append(p)
+    _PATTERN_CACHE[key] = np.stack(out) if out else None
+    return _PATTERN_CACHE[key]
+
+
+def check_mask_2d(mat, n=2, m=4) -> bool:
+    """True iff every complete m x m block keeps <= n nonzeros per row
+    AND per column."""
+    a = np.asarray(mat)
+    if a.ndim != 2 or a.shape[0] < m or a.shape[1] < m:
+        return False
+    R = (a.shape[0] // m) * m
+    C = (a.shape[1] // m) * m
+    for r0 in range(0, R, m):
+        for c0 in range(0, C, m):
+            blk = np.abs(a[r0:r0 + m, c0:c0 + m]) > 0
+            if (blk.sum(axis=1) > n).any() or (blk.sum(axis=0) > n).any():
+                return False
+    return True
 
 
 def check_mask_1d(mat, n=2, m=4) -> bool:
@@ -71,21 +163,25 @@ def reset_excluded_layers(model=None):
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
-    """Mask every Linear weight to n:m sparsity. Masks are recorded on
-    each pruned layer so a decorate()'d optimizer managing its params
-    re-applies them after every step. Returns {param_name: mask} for
-    the layers whose weights actually changed."""
+    """Mask every Linear weight to n:m sparsity ('mask_1d' along the
+    reduction dim, or 'mask_2d_greedy' per m x m block). Masks are
+    recorded on each pruned layer so a decorate()'d optimizer managing
+    its params re-applies them after every step. Returns
+    {param_name: mask} for the layers whose weights actually changed."""
     import jax.numpy as jnp
 
-    if mask_algo not in ("mask_1d",):
-        raise NotImplementedError(f"mask_algo={mask_algo!r}; 'mask_1d' only")
+    makers = {"mask_1d": create_mask,
+              "mask_2d_greedy": create_mask_2d_greedy}
+    if mask_algo not in makers:
+        raise NotImplementedError(
+            f"mask_algo={mask_algo!r}; valid: {sorted(makers)}")
     excluded = getattr(model, "_asp_excluded", set())
     out = {}
     for name, sub in model.named_sublayers():
         if name in excluded or not isinstance(sub, nn.Linear):
             continue
         w = sub.weight
-        mask = create_mask(np.asarray(w._array), n=n, m=m)
+        mask = makers[mask_algo](np.asarray(w._array), n=n, m=m)
         if not (mask == 0).any():
             continue  # nothing prunable (e.g. dim < m): not "pruned"
         w._array = (jnp.asarray(np.asarray(w._array, np.float32) * mask)

@@ -325,3 +325,31 @@ def test_hybrid_coo_partial_sparse_dim():
     np.testing.assert_array_equal(np.asarray(sp.values()._array),
                                   [[5.0, 0.0]])
     np.testing.assert_allclose(np.asarray(sp.to_dense()._array), a)
+
+
+def test_asp_mask_2d_greedy():
+    from paddle_tpu.incubate import asp
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(8, 8).astype(np.float32)
+    mask = asp.create_mask_2d_greedy(w, n=2, m=4)
+    assert asp.check_mask_2d(w * mask, n=2, m=4)
+    # exactly n*m survivors per complete block
+    for r in range(0, 8, 4):
+        for c in range(0, 8, 4):
+            assert mask[r:r + 4, c:c + 4].sum() == 8
+    # greedy keeps the largest entry of every block
+    for r in range(0, 8, 4):
+        for c in range(0, 8, 4):
+            blk = np.abs(w[r:r + 4, c:c + 4])
+            i, j = np.unravel_index(blk.argmax(), blk.shape)
+            assert mask[r + i, c + j] == 1.0
+    # a 1d-only mask generally violates the 2d column constraint check
+    assert not asp.check_mask_2d(np.eye(8) * 0 + [1, 1, 0, 0] * 2)
+
+    # prune_model accepts the algo and sparsity holds under training
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    masks = asp.prune_model(model, n=2, m=4, mask_algo="mask_2d_greedy")
+    assert masks
+    assert asp.check_mask_2d(np.asarray(model[0].weight._array))

@@ -113,6 +113,45 @@ class GPTAttention(nn.Layer):
             return out, new_cache
         return out
 
+    def forward_prefill(self, x):
+        """Causal forward that ALSO returns this layer's k/v for the
+        whole (padded) buffer — fills the fixed-size decode cache."""
+        B, S, H = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = mp.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=0.0, training=False)
+        return self.out_proj(mp.reshape(out, [B, S, H])), k, v
+
+    def forward_decode(self, x, kcache, vcache, pos):
+        """One-token decode against a FIXED-size cache (the jit-friendly
+        KV cache: no growing concat). x [B,1,H]; kcache/vcache
+        [B,L,heads,D]; pos may be a traced scalar. Writes this token's
+        k/v at `pos`, attends over positions <= pos (additive mask),
+        returns (out [B,1,H], new_kcache, new_vcache)."""
+        import paddle_tpu as paddle
+
+        B, S, H = x.shape  # S == 1
+        L = kcache.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = mp.reshape(qkv, [B, 1, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)        # [B,1,heads,D]
+        slot = (paddle.arange(L) == pos).reshape([1, L, 1, 1])
+        kcache = paddle.where(slot, k, kcache)
+        vcache = paddle.where(slot, v, vcache)
+        # additive mask over the buffer: future slots (and the padded
+        # tail) are -inf
+        allowed = (paddle.arange(L) <= pos)
+        attn_mask = paddle.where(
+            allowed, paddle.zeros([L]),
+            paddle.full([L], -1e30)).reshape([1, 1, 1, L])
+        out = F.scaled_dot_product_attention(
+            q, kcache, vcache, attn_mask=attn_mask, dropout_p=0.0,
+            training=False)
+        return (self.out_proj(mp.reshape(out, [B, 1, H])), kcache,
+                vcache)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -160,6 +199,18 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x
 
+    def forward_prefill(self, x):
+        a, k, v = self.attn.forward_prefill(self.ln1(x))
+        x = x + a
+        return x + self.mlp(self.ln2(x)), k, v
+
+    def forward_decode(self, x, kcache, vcache, pos):
+        a, kcache, vcache = self.attn.forward_decode(self.ln1(x),
+                                                     kcache, vcache,
+                                                     pos)
+        x = x + a
+        return x + self.mlp(self.ln2(x)), kcache, vcache
+
 
 class GPTModel(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -186,6 +237,49 @@ class GPTModel(nn.Layer):
             h = blk(h)
         return self.ln_f(h)
 
+    def forward_prefill(self, input_ids):
+        """Fill the decode caches: causal forward over the (padded)
+        buffer, collecting per-layer k/v stacked on a leading layer
+        axis (single Tensors, so a compiled decode loop carries them)."""
+        B, S = input_ids.shape
+        h = self.wte(input_ids) + self.wpe(
+            paddle.arange(S, dtype="int32"))
+        ks, vs = [], []
+        for blk in self.blocks:
+            h, k, v = blk.forward_prefill(h)
+            ks.append(k)
+            vs.append(v)
+        return self.ln_f(h), mp.stack(ks, axis=0), mp.stack(vs, axis=0)
+
+    def forward_decode(self, token_ids, pos, kstack, vstack):
+        """One decode step: token_ids [B,1], pos scalar (may be traced),
+        kstack/vstack [num_layers, B, L, heads, D]. Returns
+        (hidden [B,1,H], new_kstack, new_vstack)."""
+        h = self.wte(token_ids) + self.wpe(
+            mp.reshape(pos.astype("int32") if hasattr(pos, "astype")
+                       else paddle.to_tensor(pos, dtype="int32"), [1]))
+        nks, nvs = [], []
+        for i, blk in enumerate(self.blocks):
+            h, nk, nv = blk.forward_decode(h, kstack[i], vstack[i], pos)
+            nks.append(nk)
+            nvs.append(nv)
+        return (self.ln_f(h), mp.stack(nks, axis=0),
+                mp.stack(nvs, axis=0))
+
+
+def _transformed_method(cls, name):
+    """Lazily dy2static-transform an unbound method ONCE per class (the
+    transform is source-level; callers get a cached converted function
+    whose tensor-`while` loops run as lax.while_loop under any trace)."""
+    cache_name = f"_{name}_jst"
+    fn = cls.__dict__.get(cache_name)
+    if fn is None:
+        from paddle_tpu.jit.dy2static import transform_function
+
+        fn = transform_function(getattr(cls, name))
+        setattr(cls, cache_name, staticmethod(fn))
+    return fn
+
 
 class GPTForCausalLM(nn.Layer):
     """LM head ties to wte (SharedLayerDesc analog, pp_layers.py:77)."""
@@ -211,24 +305,51 @@ class GPTForCausalLM(nn.Layer):
             mp.reshape(logits, [-1, self.config.vocab_size]),
             mp.reshape(labels, [-1]))
 
-    def generate(self, input_ids, max_length=None, eos_token_id=None):
+    def generate(self, input_ids, max_length=None, eos_token_id=None,
+                 use_cache=False):
         """Greedy decode (generation_utils GenerationMixin.greedy_search
         analog). Written as a data-dependent `while` over a fixed-size
         token buffer so that under @to_static the WHOLE decode compiles
         to ONE program with a lax.while_loop inside (dy2static
         convert_while_loop — the run-to-completion decode loop); eager
-        calls run the same code as a python loop. No KV cache: each
-        step re-runs the causal forward over the buffer (the
-        correctness-first path; a cache is a pure optimization).
+        calls run the same code as a python loop.
+
+        use_cache=False re-runs the causal forward over the buffer per
+        token (correctness-first); use_cache=True is the fixed-buffer
+        KV-cache path (forward_prefill + per-token forward_decode — the
+        layer caches are stacked Tensors so the compiled loop carries
+        them; O(prefix) per token instead of O(prefix^2)). Compiling
+        the cached loop for a very deep model is a significant one-time
+        cost through remote-compile setups (the whole 24-layer step is
+        one program); small/medium configs compile in seconds.
 
         input_ids [B, S0] -> tokens [B, max_length] (positions past an
-        early EOS keep repeating EOS because `done` rows freeze)."""
-        import paddle_tpu as paddle
+        early EOS keep repeating EOS because `done` rows freeze).
 
+        Generation is an eval-mode operation: with use_cache=True and
+        active dropout the cached path (which never applies dropout)
+        would diverge from the plain path, so it refuses."""
         max_length = max_length or self.config.max_seq_len
         B, S0 = input_ids.shape
         if max_length < S0:
             raise ValueError(f"max_length={max_length} < prompt {S0}")
+        if use_cache and self.training and self.config.dropout > 0:
+            raise ValueError(
+                "generate(use_cache=True) is deterministic (no dropout) "
+                "— call model.eval() first")
+        # route through dy2static-transformed bodies so the decode
+        # while converts to lax.while_loop even when generate is CALLED
+        # from inside a larger traced function (not itself the
+        # to_static entry point)
+        impl = _transformed_method(
+            type(self),
+            "_generate_cached" if use_cache else "_generate_plain")
+        return impl(self, input_ids, max_length, eos_token_id)
+
+    def _generate_plain(self, input_ids, max_length, eos_token_id):
+        import paddle_tpu as paddle
+
+        B, S0 = input_ids.shape
         pad = paddle.zeros([B, max_length - S0], dtype=input_ids.dtype)
         tokens = mp.concat([input_ids, pad], axis=1)      # [B, L] static
         positions = paddle.arange(max_length)             # [L]
@@ -251,6 +372,58 @@ class GPTForCausalLM(nn.Layer):
                 done = paddle.logical_or(done, nxt == eos_token_id)
             write = (positions == pos).unsqueeze(0)       # [1, L]
             tokens = paddle.where(write, nxt.unsqueeze(-1), tokens)
+            pos = pos + 1
+        return tokens
+
+    def _logits_of(self, hidden):
+        return paddle.matmul(hidden, self.gpt.wte.weight,
+                             transpose_y=True)
+
+    def _generate_cached(self, input_ids, max_length, eos_token_id):
+        import paddle_tpu as paddle
+
+        B, S0 = input_ids.shape
+        L = max_length
+        pad = paddle.zeros([B, L - S0], dtype=input_ids.dtype)
+        tokens = mp.concat([input_ids, pad], axis=1)
+        positions = paddle.arange(L)
+        # prefill over the PROMPT only (O(S0^2) attention, not O(L^2));
+        # cache buffers zero-pad to L — every slot >= S0 is overwritten
+        # before it is ever attended (the decode mask is <= pos)
+        hidden, kstack, vstack = self.gpt.forward_prefill(input_ids)
+        def pad_cache(c):
+            z = paddle.zeros(list(c.shape[:2]) + [L - S0] +
+                             list(c.shape[3:]), dtype=c.dtype)
+            return mp.concat([c, z], axis=2)
+
+        kstack = pad_cache(kstack)
+        vstack = pad_cache(vstack)
+        # only the last prompt position's logits matter: reduce hidden
+        # to [B,H] BEFORE the vocab projection (1/L the matmul)
+        first_logits = self._logits_of(hidden[:, S0 - 1])
+        cur = first_logits.argmax(axis=-1).astype(input_ids.dtype)
+        done = (input_ids.sum(axis=1) * 0).astype("bool")
+        if eos_token_id is not None:
+            done = paddle.logical_or(done, cur == eos_token_id)
+        tokens = paddle.where((positions == S0).unsqueeze(0),
+                              cur.unsqueeze(-1), tokens)
+        pos = S0
+        # decode: token at `pos` goes in, token at pos+1 comes out
+        # (h_step is a fresh name: the prefill `hidden` is [B,L,H] and
+        # must not be carried against the loop's [B,1,H] activations)
+        while paddle.logical_and(paddle.logical_not(done.all()),
+                                 paddle.to_tensor(pos < L - 1)):
+            h_step, kstack, vstack = self.gpt.forward_decode(
+                cur.unsqueeze(-1), pos, kstack, vstack)
+            nxt = self._logits_of(h_step)[:, 0].argmax(axis=-1) \
+                .astype(tokens.dtype)
+            if eos_token_id is not None:
+                eos = paddle.full([1], eos_token_id, tokens.dtype)
+                nxt = paddle.where(done, eos.expand([B]), nxt)
+                done = paddle.logical_or(done, nxt == eos_token_id)
+            tokens = paddle.where((positions == pos + 1).unsqueeze(0),
+                                  nxt.unsqueeze(-1), tokens)
+            cur = nxt
             pos = pos + 1
         return tokens
 

@@ -390,3 +390,43 @@ def test_for_tensor_bound_loop_var_after_loop():
 
     got = np.asarray(run(np.array([10.0], np.float32), np.int32(3)))
     np.testing.assert_allclose(got, [32.0])  # 3*10 + i=2
+
+
+def test_gpt_generate_kv_cache_matches_uncached():
+    """The fixed-buffer KV-cache decode (prefill + forward_decode) must
+    produce the SAME tokens as the recompute-everything path, eager and
+    under to_static."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(3)
+    cfg = GPTConfig.tiny(vocab=64, hidden=32, layers=2, heads=2, seq=16)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(5).randint(0, 64, (2, 4)).astype(np.int32))
+
+    plain = np.asarray(model.generate(ids, max_length=12)._array)
+    cached = np.asarray(model.generate(ids, max_length=12,
+                                       use_cache=True)._array)
+    np.testing.assert_array_equal(cached, plain)
+
+    compiled = jit.to_static(
+        lambda t: model.generate(t, max_length=12, use_cache=True))
+    got = np.asarray(compiled(ids)._array)
+    np.testing.assert_array_equal(got, plain)
+
+
+def test_gpt_generate_kv_cache_eos():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(4)
+    cfg = GPTConfig.tiny(vocab=16, hidden=16, layers=1, heads=2, seq=12)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(6).randint(0, 16, (1, 3)).astype(np.int32))
+    a = np.asarray(model.generate(ids, max_length=10,
+                                  eos_token_id=3)._array)
+    b = np.asarray(model.generate(ids, max_length=10, eos_token_id=3,
+                                  use_cache=True)._array)
+    np.testing.assert_array_equal(a, b)

@@ -185,13 +185,16 @@ def pallas_sdpa_forward(q, k, v, causal: bool = True, scale=None,
 # scaled_dot_product_attention).
 
 
-def _shortseq_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, hb):
+def _shortseq_fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *,
+                         scale, hb):
     for h in range(hb):
         q = q_ref[h]  # [S, D] bf16 — MXU bf16 passes, f32 accumulate
         k = k_ref[h]
         v = v_ref[h]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        # additive key mask (padding): [S] broadcast over query rows
+        s = s + km_ref[h, 0][None, :]
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
@@ -205,8 +208,8 @@ def _shortseq_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, hb):
                                       (8, q.shape[0]))
 
 
-def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                         dq_ref, dk_ref, dv_ref, *, scale, hb):
+def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, do_ref,
+                         lse_ref, dq_ref, dk_ref, dv_ref, *, scale, hb):
     for h in range(hb):
         q = q_ref[h]
         k = k_ref[h]
@@ -214,6 +217,7 @@ def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         do = do_ref[h]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        s = s + km_ref[h, 0][None, :]
         p = jnp.exp(s - lse_ref[h, 0][:, None])  # [S,S] f32, softmaxed
         pb = p.astype(v.dtype)
         dv = jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
@@ -249,7 +253,7 @@ def _shortseq_hb(BH, S=512, D=64, itemsize=2):
     return 1
 
 
-def _shortseq_call_fwd(q, k, v, scale, hb, interpret=False):
+def _shortseq_call_fwd(q, k, v, kmask, scale, hb, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -260,20 +264,21 @@ def _shortseq_call_fwd(q, k, v, scale, hb, interpret=False):
         return pl.BlockSpec((hb, S, D), lambda i: (i, 0, 0),
                             memory_space=pltpu.VMEM)
 
+    row = pl.BlockSpec((hb, 8, S), lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(_shortseq_fwd_kernel, scale=scale, hb=hb),
         grid=grid,
         interpret=interpret,
-        in_specs=[blk(), blk(), blk()],
-        out_specs=[blk(),
-                   pl.BlockSpec((hb, 8, S), lambda i: (i, 0, 0),
-                                memory_space=pltpu.VMEM)],
+        in_specs=[blk(), blk(), blk(), row],
+        out_specs=[blk(), row],
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype),
                    jax.ShapeDtypeStruct((BH, 8, S), jnp.float32)],
-    )(q, k, v)
+    )(q, k, v, kmask)
 
 
-def _shortseq_call_bwd(q, k, v, o, do, lse, scale, hb, interpret=False):
+def _shortseq_call_bwd(q, k, v, kmask, o, do, lse, scale, hb,
+                       interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -284,55 +289,64 @@ def _shortseq_call_bwd(q, k, v, o, do, lse, scale, hb, interpret=False):
         return pl.BlockSpec((hb, S, D), lambda i: (i, 0, 0),
                             memory_space=pltpu.VMEM)
 
+    row = pl.BlockSpec((hb, 8, S), lambda i: (i, 0, 0),
+                       memory_space=pltpu.VMEM)
     return pl.pallas_call(
         functools.partial(_shortseq_bwd_kernel, scale=scale, hb=hb),
         grid=grid,
         interpret=interpret,
-        in_specs=[blk(), blk(), blk(), blk(), blk(),
-                  pl.BlockSpec((hb, 8, S), lambda i: (i, 0, 0),
-                               memory_space=pltpu.VMEM)],
+        in_specs=[blk(), blk(), blk(), row, blk(), blk(), row],
         out_specs=[blk(), blk(), blk()],
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)] * 3,
-    )(q, k, v, o, do, lse)
+    )(q, k, v, kmask, o, do, lse)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _shortseq_attention(q, k, v, scale, interpret):
-    o, _ = _shortseq_call_fwd(q, k, v, scale,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _shortseq_attention(q, k, v, kmask, scale, interpret):
+    o, _ = _shortseq_call_fwd(q, k, v, kmask, scale,
                               _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                               interpret=interpret)
     return o
 
 
-def _shortseq_vjp_fwd(q, k, v, scale, interpret):
-    o, lse = _shortseq_call_fwd(q, k, v, scale,
+def _shortseq_vjp_fwd(q, k, v, kmask, scale, interpret):
+    o, lse = _shortseq_call_fwd(q, k, v, kmask, scale,
                                 _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                                 interpret=interpret)
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, kmask, o, lse)
 
 
 def _shortseq_vjp_bwd(scale, interpret, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _shortseq_call_bwd(q, k, v, o, do, lse, scale,
+    q, k, v, kmask, o, lse = res
+    dq, dk, dv = _shortseq_call_bwd(q, k, v, kmask, o, do, lse, scale,
                                     _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                                     interpret=interpret)
-    return dq, dk, dv
+    # the additive key mask is data, not a trained quantity
+    return dq, dk, dv, jnp.zeros_like(kmask)
 
 
 _shortseq_attention.defvjp(_shortseq_vjp_fwd, _shortseq_vjp_bwd)
 
 
-def shortseq_attention(q, k, v, scale=None, interpret=False):
+def shortseq_attention(q, k, v, scale=None, key_mask=None,
+                       interpret=False):
     """Fused short-seq bidirectional attention, [B,S,H,D] -> [B,S,H,D].
-    Requirements: S % 128 == 0, S <= 1024, D in {64, 128}. Used by
-    flash_attention for non-causal encoder shapes."""
+    Requirements: S % 128 == 0, S <= 512, D in {64, 128}. key_mask is
+    an OPTIONAL additive [B, S] float mask over KEYS (0 for real
+    tokens, -1e30/-inf for padding — the encoder attention_mask
+    convention). Used by flash_attention/sdpa for encoder shapes."""
     B, S, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
 
     def to_bh(x):
         return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
 
-    out = _shortseq_attention(to_bh(q), to_bh(k), to_bh(v), scale,
+    if key_mask is None:
+        km = jnp.zeros((B * H, 8, S), jnp.float32)
+    else:
+        km = jnp.repeat(jnp.asarray(key_mask, jnp.float32), H, axis=0)
+        km = jnp.broadcast_to(km[:, None, :], (B * H, 8, S))
+    out = _shortseq_attention(to_bh(q), to_bh(k), to_bh(v), km, scale,
                               interpret)
     return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2)
 

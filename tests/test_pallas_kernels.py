@@ -259,3 +259,43 @@ def test_causal_shape_gate():
         bq = _causal_bq(S, 128)
         assert bq and S % bq == 0 and bq >= 128
         assert 10 * bq * S <= 11 * 1024 * 1024
+
+
+def test_shortseq_attention_key_mask_interpret():
+    """The additive key (padding) mask path: masked keys contribute
+    nothing, matching dense attention with the same mask — value AND
+    gradients."""
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    rng = np.random.RandomState(2)
+    B, S, H, D = 2, 256, 3, 64
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32))
+    # row 0 pads the last 56 keys, row 1 pads nothing
+    km = np.zeros((B, S), np.float32)
+    km[0, 200:] = -1e30
+    kmj = jnp.asarray(km)
+
+    def dense(q, k, v):
+        qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(D)
+        logits = logits + kmj[:, None, None, :]
+        p = jax.nn.softmax(logits, -1)
+        return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+    out = fa.shortseq_attention(q, k, v, key_mask=kmj, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense(q, k, v)),
+                               atol=2e-3)
+
+    gk = jax.grad(lambda v: jnp.sum(fa.shortseq_attention(
+        q, k, v, key_mask=kmj, interpret=True) ** 2))(v)
+    gd = jax.grad(lambda v: jnp.sum(dense(q, k, v) ** 2))(v)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gd), atol=5e-3)
+    # padded keys receive zero dv
+    assert np.abs(np.asarray(gk)[0, 200:]).max() == 0.0

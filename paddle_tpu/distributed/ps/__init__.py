@@ -16,9 +16,11 @@ from . import service
 from .embedding import DistributedEmbedding
 from .service import (Communicator, TableClient, init_ps_rpc, is_server,
                       is_worker, run_server, stop_servers)
-from .table import MemorySparseTable, SparseAdagradRule, SparseSGDRule
+from .table import (MemorySparseTable, SparseAdagradRule, SparseSGDRule,
+                    SSDSparseTable)
 
-__all__ = ["MemorySparseTable", "SparseAdagradRule", "SparseSGDRule",
+__all__ = ["MemorySparseTable", "SSDSparseTable", "SparseAdagradRule",
+           "SparseSGDRule",
            "DistributedEmbedding", "service", "TableClient",
            "Communicator", "init_ps_rpc", "is_server", "is_worker",
            "run_server", "stop_servers"]

@@ -109,13 +109,18 @@ _RULES = {"sgd": SparseSGDRule, "adagrad": SparseAdagradRule}
 _TABLE_SPECS: dict = {}
 
 
-def _srv_ensure_table(name, dim, rule_kind, rule_kwargs, seed):
+def _srv_ensure_table(name, dim, rule_kind, rule_kwargs, seed,
+                      ssd_max_mem_rows=None):
     """Idempotent table creation (every trainer configures every
     server; first call wins — guarded: concurrent ensure RPCs from two
     trainers must not each create and clobber the other's table). A
     CONFLICTING re-ensure (different dim/rule/seed) fails here, at the
-    misconfiguration, not later as a shape error in pull()."""
-    spec = (dim, rule_kind, tuple(sorted(rule_kwargs.items())), seed)
+    misconfiguration, not later as a shape error in pull().
+    ssd_max_mem_rows enables the disk-spill tier on the server: hot
+    rows beyond the budget LRU-evict to the server's local disk
+    (ssd_sparse_table.h analog)."""
+    spec = (dim, rule_kind, tuple(sorted(rule_kwargs.items())), seed,
+            ssd_max_mem_rows)
     with _CREATE_LOCK:
         if name in _TABLES:
             if _TABLE_SPECS[name] != spec:
@@ -125,9 +130,16 @@ def _srv_ensure_table(name, dim, rule_kind, rule_kwargs, seed):
             return True
         rule = _RULES[rule_kind](**rule_kwargs)
         _TABLE_LOCKS[name] = threading.Lock()
-        _TABLES[name] = MemorySparseTable(
-            dim, rule=rule, nshards=1, seed=seed, name=name,
-            per_id_init=True)
+        if ssd_max_mem_rows:
+            from .table import SSDSparseTable
+
+            _TABLES[name] = SSDSparseTable(
+                dim, rule=rule, nshards=1, seed=seed, name=name,
+                per_id_init=True, max_mem_rows=ssd_max_mem_rows)
+        else:
+            _TABLES[name] = MemorySparseTable(
+                dim, rule=rule, nshards=1, seed=seed, name=name,
+                per_id_init=True)
         _TABLE_SPECS[name] = spec
     return True
 
@@ -147,6 +159,15 @@ def _srv_push(name, ids, grads):
 def _srv_touched(name):
     with _TABLE_LOCKS[name]:
         return _TABLES[name].touched
+
+
+def _srv_stats(name):
+    """Row-placement stats (SSD tier introspection)."""
+    with _TABLE_LOCKS[name]:
+        t = _TABLES[name]
+        return {"touched": t.touched,
+                "mem_rows": getattr(t, "mem_rows", t.touched),
+                "disk_rows": getattr(t, "disk_rows", 0)}
 
 
 def _srv_state_dict(name):
@@ -212,7 +233,8 @@ class TableClient:
     pull/push surface as MemorySparseTable, so DistributedEmbedding
     takes it via its `table=` argument unchanged."""
 
-    def __init__(self, name, dim, rule=None, seed=0, communicator=None):
+    def __init__(self, name, dim, rule=None, seed=0, communicator=None,
+                 ssd_max_mem_rows=None):
         from paddle_tpu.distributed import rpc
 
         self.name = name
@@ -228,7 +250,8 @@ class TableClient:
         kind, kwargs = _rule_spec(rule)
         for s in self._servers:
             rpc.rpc_sync(s, _srv_ensure_table,
-                         args=(name, dim, kind, kwargs, seed))
+                         args=(name, dim, kind, kwargs, seed,
+                               ssd_max_mem_rows))
         self.communicator = communicator
         if communicator is not None:
             communicator.bind(self)
@@ -280,6 +303,17 @@ class TableClient:
 
         return sum(rpc.rpc_sync(s, _srv_touched, args=(self.name,))
                    for s in self._servers)
+
+    def stats(self):
+        """Aggregated row-placement stats across servers."""
+        from paddle_tpu.distributed import rpc
+
+        out = {"touched": 0, "mem_rows": 0, "disk_rows": 0}
+        for s in self._servers:
+            st = rpc.rpc_sync(s, _srv_stats, args=(self.name,))
+            for k in out:
+                out[k] += st[k]
+        return out
 
     def state_dict(self):
         from paddle_tpu.distributed import rpc

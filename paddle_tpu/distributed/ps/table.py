@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["SparseSGDRule", "SparseAdagradRule", "MemorySparseTable"]
+__all__ = ["SparseSGDRule", "SparseAdagradRule", "MemorySparseTable",
+           "SSDSparseTable"]
 
 
 class SparseSGDRule:
@@ -265,3 +266,174 @@ class MemorySparseTable:
             shard = self._shards[s]
             shard.rows[i] = np.array(row, np.float32)
             shard.states[i] = np.array(st, np.float32)
+
+
+class SSDSparseTable(MemorySparseTable):
+    """Disk-spilling sparse table — analog of the reference's SSD tier
+    (paddle/fluid/distributed/ps/table/ssd_sparse_table.h: hot rows in
+    a memory cache, cold rows in RocksDB; the "100-billion-feature"
+    README claim rides this). Host-RAM rows beyond `max_mem_rows` are
+    LRU-evicted to an on-disk store (sqlite3 — stdlib, one file per
+    table, crash-safe enough for a cache tier); a pull of an evicted id
+    loads it back and re-heats it. The accessor state spills alongside
+    its row, so optimizer semantics are identical to the in-memory
+    table at any cache size.
+    """
+
+    def __init__(self, dim, rule=None, max_mem_rows=100_000, path=None,
+                 **kwargs):
+        import sqlite3
+        import tempfile
+        import threading
+        import weakref
+        from collections import OrderedDict
+
+        super().__init__(dim, rule=rule, **kwargs)
+        self.max_mem_rows = max(int(max_mem_rows), 1)
+        self._own_path = path is None
+        if path is None:
+            f = tempfile.NamedTemporaryFile(
+                prefix=f"{self.name}_", suffix=".sqlite", delete=False)
+            path = f.name
+            f.close()
+        self._db_path = path
+        # the PS service executes table ops from rpc handler THREADS:
+        # share one connection under a lock
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db_lock = threading.Lock()
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows (id INTEGER PRIMARY KEY, "
+            "row BLOB, state BLOB)")
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        # weakref finalizer, NOT atexit: a dropped table must be
+        # collectable, and a self-made temp file must not linger
+        self._finalizer = weakref.finalize(
+            self, _close_ssd_store, self._db,
+            path if self._own_path else None)
+        # wrap each shard's materializer with the spill-aware version
+        for shard in self._shards.values():
+            shard._materialize = self._spill_materialize(shard)
+
+    def _close(self):
+        self._finalizer()
+
+    def _touch(self, i):
+        self._lru.pop(i, None)
+        self._lru[i] = None
+
+    def _spill_materialize(self, shard):
+        base = type(shard)._materialize
+
+        def materialize(i):
+            if i not in shard.rows:
+                with self._db_lock:
+                    got = self._db.execute(
+                        "SELECT row, state FROM rows WHERE id=?",
+                        (int(i),)).fetchone()
+                    if got is not None:
+                        self._db.execute(
+                            "DELETE FROM rows WHERE id=?", (int(i),))
+                if got is not None:  # cold row: load back from disk
+                    shard.rows[i] = np.frombuffer(
+                        got[0], np.float32).copy()
+                    shard.states[i] = np.frombuffer(
+                        got[1], np.float32).copy()
+            row = base(shard, i)
+            self._touch(i)
+            return row
+
+        return materialize
+
+    # one eviction sweep (and at most one fsync) per BATCH, not per row
+    def pull(self, ids):
+        out = super().pull(ids)
+        self._maybe_evict()
+        return out
+
+    def push(self, ids, grads):
+        super().push(ids, grads)
+        self._maybe_evict()
+
+    def set_state_dict(self, state):
+        super().set_state_dict(state)
+        with self._db_lock:
+            # restored rows are authoritative: stale disk copies of the
+            # same ids must not shadow them in a later state_dict()
+            for key in state:
+                self._db.execute("DELETE FROM rows WHERE id=?",
+                                 (int(key),))
+            self._db.commit()
+        for key in state:
+            i = int(key)
+            for shard in self._shards.values():
+                if i in shard.rows:
+                    self._touch(i)  # restored rows join the LRU
+                    break
+        self._maybe_evict()
+
+    def _mem_rows(self):
+        return sum(len(s.rows) for s in self._shards.values())
+
+    def _maybe_evict(self):
+        wrote = False
+        with self._db_lock:
+            while self._mem_rows() > self.max_mem_rows and self._lru:
+                victim, _ = self._lru.popitem(last=False)  # least recent
+                for shard in self._shards.values():
+                    if victim in shard.rows:
+                        self._db.execute(
+                            "INSERT OR REPLACE INTO rows VALUES "
+                            "(?, ?, ?)",
+                            (int(victim),
+                             shard.rows.pop(victim).astype(np.float32)
+                             .tobytes(),
+                             shard.states.pop(victim).astype(np.float32)
+                             .tobytes()))
+                        wrote = True
+                        break
+            if wrote:
+                self._db.commit()
+
+    @property
+    def touched(self):
+        """Total materialized rows: hot (RAM) + spilled (disk)."""
+        with self._db_lock:
+            n_disk = self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+        return self._mem_rows() + n_disk
+
+    @property
+    def mem_rows(self):
+        return self._mem_rows()
+
+    @property
+    def disk_rows(self):
+        with self._db_lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+
+    def state_dict(self):
+        out = super().state_dict()  # the hot rows
+        with self._db_lock:
+            rows = self._db.execute(
+                "SELECT id, row, state FROM rows").fetchall()
+        for i, row, st in rows:
+            out[str(i)] = (np.frombuffer(row, np.float32).copy(),
+                           np.frombuffer(st, np.float32).copy())
+        return out
+
+
+def _close_ssd_store(db, temp_path):
+    """Finalizer for SSDSparseTable (module-level: a bound method would
+    pin the table alive)."""
+    try:
+        db.close()
+    except Exception:
+        pass
+    if temp_path is not None:
+        import os
+
+        try:
+            os.unlink(temp_path)
+        except OSError:
+            pass

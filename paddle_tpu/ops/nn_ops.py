@@ -907,11 +907,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             km = jnp.broadcast_to(
                 jnp.asarray(mask_arr)[:, 0, 0, :],
                 (q.shape[0], Skv))
-            return apply(
-                "flash_attention_keymask",
-                lambda qa, ka, va: shortseq_attention(
-                    qa, ka, va, scale=scale, key_mask=km),
-                q, k, v)
+            try:
+                return apply(
+                    "flash_attention_keymask",
+                    lambda qa, ka, va: shortseq_attention(
+                        qa, ka, va, scale=scale, key_mask=km),
+                    q, k, v)
+            except Exception as e:  # noqa: BLE001 — dense still works
+                import warnings
+
+                warnings.warn(
+                    f"shortseq key-mask kernel unavailable, dense "
+                    f"fallback: {type(e).__name__}: {e}")
 
     def fn(qa, ka, va):
         d = qa.shape[-1]

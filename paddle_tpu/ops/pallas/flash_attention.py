@@ -185,16 +185,17 @@ def pallas_sdpa_forward(q, k, v, causal: bool = True, scale=None,
 # scaled_dot_product_attention).
 
 
-def _shortseq_fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *,
-                         scale, hb):
+def _shortseq_fwd_core(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *,
+                       scale, hb):
     for h in range(hb):
         q = q_ref[h]  # [S, D] bf16 — MXU bf16 passes, f32 accumulate
         k = k_ref[h]
         v = v_ref[h]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        # additive key mask (padding): [S] broadcast over query rows
-        s = s + km_ref[h, 0][None, :]
+        if km_ref is not None:
+            # additive key mask (padding): [S] broadcast over query rows
+            s = s + km_ref[h, 0][None, :]
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         l = jnp.sum(p, axis=-1, keepdims=True)
@@ -208,8 +209,8 @@ def _shortseq_fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref, *,
                                       (8, q.shape[0]))
 
 
-def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, do_ref,
-                         lse_ref, dq_ref, dk_ref, dv_ref, *, scale, hb):
+def _shortseq_bwd_core(q_ref, k_ref, v_ref, km_ref, o_ref, do_ref,
+                       lse_ref, dq_ref, dk_ref, dv_ref, *, scale, hb):
     for h in range(hb):
         q = q_ref[h]
         k = k_ref[h]
@@ -217,7 +218,8 @@ def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, do_ref,
         do = do_ref[h]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        s = s + km_ref[h, 0][None, :]
+        if km_ref is not None:
+            s = s + km_ref[h, 0][None, :]
         p = jnp.exp(s - lse_ref[h, 0][:, None])  # [S,S] f32, softmaxed
         pb = p.astype(v.dtype)
         dv = jax.lax.dot_general(pb, do, (((0,), (0,)), ((), ())),
@@ -236,6 +238,33 @@ def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, do_ref,
         dq_ref[h] = dq.astype(dq_ref.dtype)
         dk_ref[h] = dk.astype(dk_ref.dtype)
         dv_ref[h] = dv.astype(dv_ref.dtype)
+
+
+def _shortseq_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                         scale, hb):
+    _shortseq_fwd_core(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                       scale=scale, hb=hb)
+
+
+def _shortseq_fwd_kernel_masked(q_ref, k_ref, v_ref, km_ref, o_ref,
+                                lse_ref, *, scale, hb):
+    _shortseq_fwd_core(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
+                       scale=scale, hb=hb)
+
+
+def _shortseq_bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                         dq_ref, dk_ref, dv_ref, *, scale, hb):
+    _shortseq_bwd_core(q_ref, k_ref, v_ref, None, o_ref, do_ref,
+                       lse_ref, dq_ref, dk_ref, dv_ref, scale=scale,
+                       hb=hb)
+
+
+def _shortseq_bwd_kernel_masked(q_ref, k_ref, v_ref, km_ref, o_ref,
+                                do_ref, lse_ref, dq_ref, dk_ref,
+                                dv_ref, *, scale, hb):
+    _shortseq_bwd_core(q_ref, k_ref, v_ref, km_ref, o_ref, do_ref,
+                       lse_ref, dq_ref, dk_ref, dv_ref, scale=scale,
+                       hb=hb)
 
 
 def _shortseq_hb(BH, S=512, D=64, itemsize=2):
@@ -266,14 +295,25 @@ def _shortseq_call_fwd(q, k, v, kmask, scale, hb, interpret=False):
 
     row = pl.BlockSpec((hb, 8, S), lambda i: (i, 0, 0),
                        memory_space=pltpu.VMEM)
+    out_shape = [jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                 jax.ShapeDtypeStruct((BH, 8, S), jnp.float32)]
+    if kmask is None:  # mask-free hot path: no zero-mask traffic
+        return pl.pallas_call(
+            functools.partial(_shortseq_fwd_kernel, scale=scale, hb=hb),
+            grid=grid,
+            interpret=interpret,
+            in_specs=[blk(), blk(), blk()],
+            out_specs=[blk(), row],
+            out_shape=out_shape,
+        )(q, k, v)
     return pl.pallas_call(
-        functools.partial(_shortseq_fwd_kernel, scale=scale, hb=hb),
+        functools.partial(_shortseq_fwd_kernel_masked, scale=scale,
+                          hb=hb),
         grid=grid,
         interpret=interpret,
         in_specs=[blk(), blk(), blk(), row],
         out_specs=[blk(), row],
-        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-                   jax.ShapeDtypeStruct((BH, 8, S), jnp.float32)],
+        out_shape=out_shape,
     )(q, k, v, kmask)
 
 
@@ -291,8 +331,18 @@ def _shortseq_call_bwd(q, k, v, kmask, o, do, lse, scale, hb,
 
     row = pl.BlockSpec((hb, 8, S), lambda i: (i, 0, 0),
                        memory_space=pltpu.VMEM)
+    if kmask is None:
+        return pl.pallas_call(
+            functools.partial(_shortseq_bwd_kernel, scale=scale, hb=hb),
+            grid=grid,
+            interpret=interpret,
+            in_specs=[blk(), blk(), blk(), blk(), blk(), row],
+            out_specs=[blk(), blk(), blk()],
+            out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)] * 3,
+        )(q, k, v, o, do, lse)
     return pl.pallas_call(
-        functools.partial(_shortseq_bwd_kernel, scale=scale, hb=hb),
+        functools.partial(_shortseq_bwd_kernel_masked, scale=scale,
+                          hb=hb),
         grid=grid,
         interpret=interpret,
         in_specs=[blk(), blk(), blk(), row, blk(), blk(), row],
@@ -322,7 +372,8 @@ def _shortseq_vjp_bwd(scale, interpret, res, do):
                                     _shortseq_hb(*q.shape, itemsize=q.dtype.itemsize),
                                     interpret=interpret)
     # the additive key mask is data, not a trained quantity
-    return dq, dk, dv, jnp.zeros_like(kmask)
+    return (dq, dk, dv,
+            None if kmask is None else jnp.zeros_like(kmask))
 
 
 _shortseq_attention.defvjp(_shortseq_vjp_fwd, _shortseq_vjp_bwd)
@@ -342,7 +393,7 @@ def shortseq_attention(q, k, v, scale=None, key_mask=None,
         return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
 
     if key_mask is None:
-        km = jnp.zeros((B * H, 8, S), jnp.float32)
+        km = None  # mask-free kernels: no zero-mask traffic
     else:
         km = jnp.repeat(jnp.asarray(key_mask, jnp.float32), H, axis=0)
         km = jnp.broadcast_to(km[:, None, :], (B * H, 8, S))

@@ -32,11 +32,15 @@ def main():
     service.init_ps_rpc()
     tid = service.trainer_index()
 
-    comm = Communicator(mode=mode, k_steps=3)
+    # mode "ssd" = sync communicator + disk-spill tier on the servers
+    comm_mode = "sync" if mode == "ssd" else mode
+    ssd_rows = 64 if mode == "ssd" else None
+    comm = Communicator(mode=comm_mode, k_steps=3)
     deep_client = TableClient("deep_table", 8,
                               rule=SparseAdagradRule(0.05), seed=0,
-                              communicator=comm)
-    wide_comm = Communicator(mode=mode, k_steps=3)
+                              communicator=comm,
+                              ssd_max_mem_rows=ssd_rows)
+    wide_comm = Communicator(mode=comm_mode, k_steps=3)
     wide_client = TableClient("wide_table", 1,
                               rule=SparseAdagradRule(0.05), seed=1,
                               communicator=wide_comm)
@@ -70,11 +74,12 @@ def main():
     wide_comm.stop()
 
     touched = deep_client.touched()
+    stats = deep_client.stats()
     sd = deep_client.state_dict()
     if out_file:
         with open(f"{out_file}.{tid}", "w") as f:
             json.dump({"losses": losses, "touched": touched,
-                       "state_rows": len(sd)}, f)
+                       "stats": stats, "state_rows": len(sd)}, f)
     print(f"TRAINER_DONE loss0={losses[0]:.4f} "
           f"lossN={losses[-1]:.4f} touched={touched}", flush=True)
     service.stop_servers()

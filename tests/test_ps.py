@@ -124,3 +124,94 @@ def test_per_id_init_topology_invariant():
     t2b = MemorySparseTable(dim=4, nshards=2, seed=7, per_id_init=True)
     t2b.pull(ids[::-1])
     np.testing.assert_array_equal(t2.pull(ids), t2b.pull(ids))
+
+
+def test_ssd_table_spills_and_reloads(tmp_path):
+    """SSD tier (ssd_sparse_table.h analog): rows beyond max_mem_rows
+    LRU-evict to disk with their accessor state; pulling a cold row
+    loads it back with identical values and optimizer behavior."""
+    from paddle_tpu.distributed.ps import SSDSparseTable
+
+    t = SSDSparseTable(dim=4, rule=SparseSGDRule(0.1), max_mem_rows=8,
+                       path=str(tmp_path / "t.sqlite"), seed=3)
+    ids = np.arange(20)
+    rows = t.pull(ids).copy()          # 20 rows through an 8-row cache
+    assert t.touched == 20
+    assert t.mem_rows <= 8
+    assert t.disk_rows >= 12
+    # cold rows reload with the SAME values
+    np.testing.assert_array_equal(t.pull(ids[:4]), rows[:4])
+    # pushes against a cold row apply to the reloaded copy
+    before = t.pull(np.array([0])).copy()
+    t.push(np.array([0]), np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(t.pull(np.array([0])), before - 0.1,
+                               rtol=1e-6)
+    # accessor state spills too: Adagrad semantics survive eviction
+    ta = SSDSparseTable(dim=2, rule=SparseAdagradRule(1.0, eps=0.0),
+                        max_mem_rows=2, path=str(tmp_path / "a.sqlite"))
+    g = np.array([[2.0, 2.0]], np.float32)
+    ta.push(np.array([5]), g)
+    r1 = ta.pull(np.array([5])).copy()
+    ta.pull(np.arange(100, 110))       # force id 5 to disk
+    assert ta.mem_rows <= 2
+    ta.push(np.array([5]), g)          # second step on the reloaded row
+    r2 = ta.pull(np.array([5]))
+    np.testing.assert_allclose(r2, r1 - 2.0 / np.sqrt(8.0), rtol=1e-5)
+    # checkpoint covers disk-resident rows
+    sd = t.state_dict()
+    assert len(sd) == 20
+
+
+def test_ssd_table_behaves_like_memory_table(tmp_path):
+    """Any cache size produces the same numbers as the pure-RAM table."""
+    from paddle_tpu.distributed.ps import SSDSparseTable
+
+    rs = np.random.RandomState(0)
+    mem = MemorySparseTable(dim=3, rule=SparseSGDRule(0.05), seed=9,
+                            per_id_init=True)
+    ssd = SSDSparseTable(dim=3, rule=SparseSGDRule(0.05), seed=9,
+                         per_id_init=True, max_mem_rows=4,
+                         path=str(tmp_path / "p.sqlite"))
+    for _ in range(5):
+        ids = rs.randint(0, 30, size=8)
+        g = rs.randn(8, 3).astype(np.float32)
+        mem.push(ids, g)
+        ssd.push(ids, g)
+    probe = np.arange(30)
+    np.testing.assert_allclose(ssd.pull(probe), mem.pull(probe),
+                               rtol=1e-6)
+
+
+def test_ssd_table_restore_and_budget_edge(tmp_path):
+    """Review r4: restored checkpoint rows join the LRU (evictable, no
+    KeyError on push), stale disk copies never shadow restored rows,
+    and a tiny budget still works."""
+    from paddle_tpu.distributed.ps import SSDSparseTable
+
+    src = SSDSparseTable(dim=2, rule=SparseSGDRule(0.1), max_mem_rows=50,
+                         path=str(tmp_path / "src.sqlite"))
+    src.pull(np.arange(20))
+    sd = src.state_dict()
+
+    # restore into a table whose budget is smaller than the checkpoint
+    dst = SSDSparseTable(dim=2, rule=SparseSGDRule(0.1), max_mem_rows=8,
+                         path=str(tmp_path / "dst.sqlite"))
+    dst.set_state_dict(sd)
+    assert dst.mem_rows <= 8        # restored rows spill to budget
+    assert dst.touched == 20
+    # push on any id works (the once-crashing path)
+    before = dst.pull(np.array([3])).copy()
+    dst.push(np.array([3]), np.ones((1, 2), np.float32))
+    np.testing.assert_allclose(dst.pull(np.array([3])), before - 0.1,
+                               rtol=1e-6)
+
+    # stale-disk shadowing: spill id 7, restore a NEWER value for it
+    dst2 = SSDSparseTable(dim=2, rule=SparseSGDRule(0.1), max_mem_rows=4,
+                          path=str(tmp_path / "d2.sqlite"))
+    dst2.pull(np.arange(10))        # id 7 likely on disk now
+    newer = {"7": (np.array([9.0, 9.0], np.float32),
+                   np.zeros((0,), np.float32))}
+    dst2.set_state_dict(newer)
+    assert dst2.state_dict()["7"][0].tolist() == [9.0, 9.0]
+    # no double count
+    assert dst2.touched == 10

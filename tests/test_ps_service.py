@@ -129,3 +129,15 @@ def test_communicator_async_flush_drains():
     comm.flush()
     assert sum(sent) == 4
     comm.stop()
+
+
+def test_ps_service_ssd_tier_trains_and_spills(tmp_path):
+    """Servers with the disk-spill tier (ssd_sparse_table.h analog):
+    wide&deep still converges, rows really spill to disk, and the
+    checkpoint covers hot+cold rows."""
+    results = _run_mode("ssd", tmp_path)
+    for r in results:
+        assert r["losses"][-1] < 0.45, r["losses"][-5:]
+        assert r["stats"]["disk_rows"] > 0, r["stats"]
+        assert r["stats"]["mem_rows"] <= 2 * 64  # 2 servers x budget
+        assert r["state_rows"] == r["touched"]

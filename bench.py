@@ -244,7 +244,7 @@ def main():
     else:
         sys.exit(f"unknown BENCH_MODEL={which!r}; valid: "
                  f"{sorted(table)} or 'all'")
-    flagship_failed = False
+    any_failed = False
     for name in order:
         try:
             result, info = table[name](on_tpu)
@@ -256,14 +256,13 @@ def main():
             print(json.dumps({"metric": f"{name}_FAILED", "value": 0,
                               "unit": "error", "vs_baseline": 0.0}),
                   flush=True)
-            if name == order[-1]:
-                flagship_failed = True
+            any_failed = True
             if len(order) == 1:
                 raise
             continue
         print(json.dumps(result), flush=True)
         print(f"# backend={backend} {info}", file=sys.stderr)
-    if flagship_failed:
+    if any_failed:
         sys.exit(1)
 
 

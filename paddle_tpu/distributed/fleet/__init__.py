@@ -63,15 +63,17 @@ def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStra
 
     need = math.prod(degrees.values())
     ndev = len(jax.devices())
-    if need > ndev:
+    if need > ndev or ndev % need != 0:
         asked = " x ".join(f"{k.split('_')[0]}={v}"
                            for k, v in sorted(degrees.items())
                            if v > 1) or "1"
         raise ValueError(
             f"hybrid_configs asks for {asked} = {need} devices, but "
-            f"only {ndev} are visible — fix the degrees or the launch "
-            "size (the reference raises the same way when nranks != "
-            "degree product, topology.py CommunicateTopology)")
+            f"{ndev} are visible — the degree product must divide the "
+            "device count. (The reference requires nranks == degree "
+            "product exactly; this build additionally supports a "
+            "prefix mesh over the first `product` devices, so any "
+            "divisor of the device count is accepted.)")
     hcg = HybridCommunicateGroup(
         dp=degrees["dp_degree"],
         mp=degrees["mp_degree"],

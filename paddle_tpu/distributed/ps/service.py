@@ -362,16 +362,28 @@ class Communicator:
         self._queue: queue.Queue = queue.Queue(maxsize=queue_size)
         self._thread = None
         self._err = None
+        self._stopped = False
         self._geo_acc: dict = {}
         self._geo_count = 0
         self._lock = threading.Lock()
 
     def bind(self, client):
         self._client = client
+        self._stopped = False
         if self.mode == "async" and self._thread is None:
             self._thread = threading.Thread(target=self._drain,
                                             daemon=True)
             self._thread.start()
+
+    def _raise_pending(self):
+        """Surface a drain-thread error exactly once — a stale _err must
+        not poison every later push/flush after the caller handled it.
+        The swap happens under the lock so a concurrent drain failure
+        can't be clobbered to None."""
+        with self._lock:
+            err, self._err = self._err, None
+        if err is not None:
+            raise err
 
     def _drain(self):
         while True:
@@ -382,13 +394,19 @@ class Communicator:
                 ids, grads = item
                 self._client.push_direct(ids, grads, wait=True)
             except Exception as e:  # surface on the next push/flush
-                self._err = e
+                with self._lock:
+                    self._err = e
             finally:
                 self._queue.task_done()
 
     def push(self, ids, grads):
-        if self._err is not None:
-            raise self._err
+        if self._stopped:
+            raise RuntimeError(
+                "Communicator.push after stop(): the communicator is "
+                "stopped (in async mode the drain thread is gone and a "
+                "push would block forever) — call bind() again or "
+                "create a new Communicator")
+        self._raise_pending()
         ids = np.asarray(ids, np.int64).ravel()
         grads = np.asarray(grads, np.float32).reshape(
             len(ids), self._client.dim)
@@ -427,14 +445,19 @@ class Communicator:
             self._queue.join()
         elif self.mode == "geo":
             self._ship_geo()
-        if self._err is not None:
-            raise self._err
+        self._raise_pending()
 
     def stop(self):
         # flush FIRST in every mode: geo deltas accumulated since the
-        # last k-step boundary must ship, thread or no thread
-        self.flush()
-        if self._thread is not None:
-            self._queue.put(None)
-            self._thread.join(timeout=10)
-            self._thread = None
+        # last k-step boundary must ship, thread or no thread. The
+        # shutdown itself runs even when flush surfaces a drain error —
+        # otherwise the push-after-stop guard never engages on exactly
+        # the failure path it exists for.
+        try:
+            self.flush()
+        finally:
+            self._stopped = True
+            if self._thread is not None:
+                self._queue.put(None)
+                self._thread.join(timeout=10)
+                self._thread = None

@@ -123,10 +123,14 @@ def _block_patterns_2d(n, m):
 
 def check_mask_2d(mat, n=2, m=4) -> bool:
     """True iff every complete m x m block keeps <= n nonzeros per row
-    AND per column."""
+    AND per column. A matrix with no complete m x m block is vacuously
+    compliant (matches check_mask_1d's remainder contract — small layers
+    survive a prune-then-verify round trip)."""
     a = np.asarray(mat)
-    if a.ndim != 2 or a.shape[0] < m or a.shape[1] < m:
+    if a.ndim != 2:
         return False
+    if a.shape[0] < m or a.shape[1] < m:
+        return True
     R = (a.shape[0] // m) * m
     C = (a.shape[1] // m) * m
     for r0 in range(0, R, m):
@@ -139,10 +143,13 @@ def check_mask_2d(mat, n=2, m=4) -> bool:
 
 def check_mask_1d(mat, n=2, m=4) -> bool:
     """True iff every complete m-group keeps at most n nonzeros (the
-    dense remainder of a non-divisible dim is ignored)."""
+    dense remainder of a non-divisible dim is ignored; a matrix with no
+    complete group is vacuously compliant, same as check_mask_2d)."""
     a = np.asarray(mat)
-    if a.ndim < 2 or a.shape[0] < m:
+    if a.ndim < 2:
         return False
+    if a.shape[0] < m:
+        return True
     main = (a.shape[0] // m) * m
     nz = (np.abs(a[:main]).reshape(main // m, m, -1) > 0).sum(axis=1)
     return bool((nz <= n).all())

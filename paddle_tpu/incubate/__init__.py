@@ -7,9 +7,9 @@ import-path parity.
 from paddle_tpu.distributed.moe import MoELayer, switch_gating, top2_gating
 from paddle_tpu.nn import TransformerEncoderLayer as FusedTransformerLayer
 
-from . import asp, checkpoint, distributed, optimizer
+from . import asp, autograd, checkpoint, distributed, optimizer
 from .optimizer import LookAhead, ModelAverage
 
 __all__ = ["MoELayer", "top2_gating", "switch_gating",
-           "FusedTransformerLayer", "distributed", "asp", "checkpoint",
-           "optimizer", "LookAhead", "ModelAverage"]
+           "FusedTransformerLayer", "distributed", "asp", "autograd",
+           "checkpoint", "optimizer", "LookAhead", "ModelAverage"]

@@ -73,14 +73,26 @@ def build_parser():
                         "ElasticManager watch/restart analog, "
                         "fleet/elastic/manager.py)")
     p.add_argument("--elastic-min", type=int, default=0,
-                   help="elastic scale-in: on each restart drop one rank "
-                        "(a lost host leaves the pod) down to this "
-                        "minimum — ranks renumber 0..n-1 and the new "
-                        "world re-rendezvouses; 0 disables (restarts "
-                        "keep the original size). Scripts resume from "
-                        "their checkpoint under the new "
+                   help="elastic mode: on each restart the pod is "
+                        "resized to the membership registry's LIVE set "
+                        "(survivors + rejoined members), clamped to "
+                        "[min, --elastic-max] — ranks renumber 0..n-1 "
+                        "and the new world re-rendezvouses; 0 disables "
+                        "(restarts keep the original size, the "
+                        "reference's FAULT_TOLERANCE level). Scripts "
+                        "resume from their checkpoint under the new "
                         "PADDLE_TRAINERS_NUM (elastic/manager.py:126 "
-                        "membership-change analog)")
+                        "ElasticManager analog)")
+    p.add_argument("--elastic-max", type=int, default=0,
+                   help="elastic scale-out ceiling (reference --np "
+                        "MIN:MAX upper bound, manager.py:498). 0 = the "
+                        "initial world size")
+    p.add_argument("--elastic-master", default=None,
+                   help="ip:port to serve the membership registry on "
+                        "(the etcd/ETCDMaster analog). Default: an "
+                        "auto-picked port. Give an explicit endpoint so "
+                        "recovered hosts can rejoin via `python -m "
+                        "paddle_tpu.distributed.launch.elastic join`")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
@@ -100,18 +112,24 @@ def _world_size(args) -> int:
 
 
 def _rank_env(args, rank: int, master: str, server_rank=None,
-              node_rank=None) -> dict:
+              node_rank=None, rpc_master=None,
+              elastic_endpoint=None) -> dict:
     from paddle_tpu.distributed.spawn import rank_env_overrides
 
     env = dict(os.environ)
     for k, v in rank_env_overrides(rank, _world_size(args), master,
                                    args.backend, args.devices_per_proc,
                                    nservers=args.servers,
-                                   server_rank=server_rank).items():
+                                   server_rank=server_rank,
+                                   rpc_master=rpc_master).items():
         if v is None:
             env.pop(k, None)
         else:
             env[k] = v
+    if elastic_endpoint:
+        # lets a recovered host's agent (or a test worker standing in
+        # for one) find the membership registry
+        env["PADDLE_ELASTIC_MASTER"] = elastic_endpoint
     if args.nprocs_per_node and server_rank is None:
         # node topology env (reference: PADDLE_TRAINERS_NUM plus the
         # node/local split the multi-node launcher derives rank from)
@@ -132,45 +150,134 @@ def _stream(proc, label):
 def launch(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _normalize_topology(args)
+    from paddle_tpu.distributed.spawn import probe_free_port
+
     if args.master:
-        master, probe = args.master, None
+        # multi-host: the rpc master must be deterministic across
+        # launchers, so init_rpc keeps the coordinator+1 convention
+        # relative to the EXPLICIT master (single-host concurrent jobs
+        # — the collision case — always auto-pick below)
+        master, probes, rpc_master = args.master, [], None
     else:
-        # hold the probe socket (SO_REUSEADDR) until the ranks are
-        # spawned so another process can't grab the auto-picked
-        # coordinator port in the selection->bind window; rank 0's
-        # coordination service binds with reuse and takes over
-        probe = socket.socket()
-        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        probe.bind(("127.0.0.1", 0))
-        master = f"127.0.0.1:{probe.getsockname()[1]}"
-    rc = _launch_once(args, master, probe)
-    # elastic restart loop (ElasticManager.watch -> restart analog):
-    # a failed pod is torn down and relaunched — whole by default, or
-    # scaled in by one rank per restart with --elastic-min (the
-    # membership-change path: the new pod re-rendezvouses at the
-    # smaller world size and scripts resume from their checkpoint)
-    restarts = 0
-    while rc != 0 and restarts < args.max_restarts:
-        restarts += 1
-        if args.elastic_min and args.nprocs_per_node:
-            if args.nnodes > args.elastic_min:
-                args.nnodes -= 1  # a lost NODE leaves the pod
-                sys.stderr.write(
-                    f"[launch] scale-in: relaunching with "
-                    f"{args.nnodes} nodes\n")
-        elif args.elastic_min and args.nprocs > args.elastic_min:
-            args.nprocs -= 1
+        # hold the probe sockets (SO_REUSEADDR) until the ranks are
+        # spawned so another process can't grab the auto-picked ports
+        # in the selection->bind window; rank 0's services bind with
+        # reuse and take over. The second port is the job-private rpc
+        # rendezvous endpoint (r4 weak #4: coordinator+1 collided
+        # across concurrent jobs).
+        p1, master = probe_free_port()
+        p2, rpc_master = probe_free_port()
+        probes = [p1, p2]
+
+    # membership registry (etcd/ETCDMaster analog) — started whenever
+    # restarts are possible, so the restart size comes from the LIVE
+    # set instead of a blind decrement (manager.py:422 host matching).
+    # Node 0 only: elastic restart coordination spans ONE launcher's
+    # pod; per-host launchers (--node-rank > 0) restart independently
+    # and cross-host membership is out of scope (a recovered host
+    # rejoins the node-0 pod via `launch.elastic join`).
+    emaster = None
+    if args.max_restarts > 0 and args.node_rank in (None, 0):
+        from .elastic import ElasticMaster
+
+        if args.elastic_master:
+            eip, eport = args.elastic_master.rsplit(":", 1)
+            emaster = ElasticMaster(eip, int(eport))
+        else:
+            emaster = ElasticMaster()
+        # the scale-out ceiling is fixed at job start (reference --np
+        # MIN:MAX), independent of later scale-ins
+        if not args.elastic_max:
+            args.elastic_max = (args.nnodes if args.nprocs_per_node
+                                else args.nprocs)
+
+    def _scale_out_ok(restarts_used):
+        """A joiner-triggered teardown is only worth it when a restart
+        slot remains to relaunch AND the pod isn't already at the
+        ceiling — otherwise a late joiner would convert a healthy job
+        into a failure (or burn a slot relaunching at the same size)."""
+        current = args.nnodes if args.nprocs_per_node else args.nprocs
+        return (args.elastic_min > 0
+                and restarts_used < args.max_restarts
+                and current < args.elastic_max)
+
+    try:
+        rc = _launch_once(args, master, probes, rpc_master=rpc_master,
+                          emaster=emaster,
+                          allow_scale_out=_scale_out_ok(0))
+        # elastic restart loop (ElasticManager.watch -> restart analog):
+        # a failed pod is torn down and relaunched — at the same size by
+        # default (FAULT_TOLERANCE), or resized to the registry's live
+        # set with --elastic-min (ELASTIC level: true survivor-count
+        # scale-in, manager.py:521, and rejoin scale-out, :498)
+        restarts = 0
+        while rc != 0 and restarts < args.max_restarts:
+            restarts += 1
+            if args.elastic_min and emaster is not None:
+                _elastic_resize(args, emaster)
             sys.stderr.write(
-                f"[launch] scale-in: relaunching with "
-                f"{args.nprocs} ranks\n")
-        sys.stderr.write(
-            f"[launch] pod failed (rc={rc}); restart "
-            f"{restarts}/{args.max_restarts}\n")
-        rc = _launch_once(args, master, None, attempt=restarts)
-    return rc
+                f"[launch] pod failed (rc={rc}); restart "
+                f"{restarts}/{args.max_restarts}\n")
+            rc = _launch_once(args, master, [], attempt=restarts,
+                              rpc_master=rpc_master, emaster=emaster,
+                              allow_scale_out=_scale_out_ok(restarts))
+        return rc
+    finally:
+        if emaster is not None:
+            emaster.close()
 
 
-def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
+def _elastic_resize(args, emaster):
+    """Resize the pod to the registry's live set at a restart boundary:
+    launcher-owned survivors (failed members already left) plus any
+    externally rejoined members, clamped to [--elastic-min,
+    --elastic-max]. External joiners are absorbed (their registration
+    is consumed) — the relaunch spawns their capacity as local ranks."""
+    node_mode = bool(args.nprocs_per_node)
+    current = args.nnodes if node_mode else args.nprocs
+    live = emaster.live()
+    joiners = [m for m, info in live.items() if info.get("_external")]
+    survivors = len(live) - len(joiners)
+    if len(live) == 0:
+        return  # every member died: plain fixed-size restart
+    new = max(min(survivors + len(joiners), args.elastic_max),
+              args.elastic_min)
+    for j in joiners:
+        emaster.leave(j)
+    if new == current:
+        return
+    unit = "nodes" if node_mode else "ranks"
+    verb = "scale-in" if new < current else "scale-out"
+    sys.stderr.write(
+        f"[launch] {verb}: relaunching with {new} {unit}\n")
+    if node_mode:
+        args.nnodes = new
+    else:
+        args.nprocs = new
+
+
+# returned by _launch_once when the pod was torn down because NEW
+# members joined (re-rendezvous at the bigger world); any nonzero value
+# drives the restart loop, this one just names the reason (EX_TEMPFAIL)
+SCALE_OUT_RC = 75
+
+
+def _teardown(procs, pending):
+    """SIGTERM the surviving ranks and reap them (kill stragglers)."""
+    for j in pending:
+        procs[j].send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for j in pending:
+        try:
+            procs[j].wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            procs[j].kill()
+    pending.clear()
+
+
+def _launch_once(args, master: str, probes, attempt: int = 0,
+                 rpc_master=None, emaster=None,
+                 allow_scale_out: bool = False) -> int:
     procs = []
     streams = []
     logs = []
@@ -193,19 +300,41 @@ def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
         # PS servers live on node 0 only: with per-host launchers every
         # node would otherwise spawn colliding server ranks
         members += [("server", s, 0) for s in range(args.servers)]
+
+    def _member_name(i):
+        """Registry identity for proc i: per-node in node mode (a lost
+        host is the membership unit), per-rank otherwise. Servers are
+        not elastic members."""
+        kind, rank, node = members[i]
+        if kind != "trainer":
+            return None
+        return f"node{node}" if args.nprocs_per_node else f"rank{rank}"
+
+    if emaster is not None:
+        # launcher-owned members: permanent lease, perfect liveness
+        # information — failure is reported via leave() below. Stale
+        # identities from the previous attempt are cleared first.
+        emaster.clear_owned()
+        for i in range(len(members)):
+            name = _member_name(i)
+            if name is not None:
+                emaster.register(name, info={"attempt": attempt})
     try:
         for kind, rank, node in members:
             env = _rank_env(args, rank, master,
                             server_rank=rank if kind == "server"
                             else None,
-                            node_rank=node)
-            if probe is not None:
-                # release the coordinator port at the last moment (rank
-                # 0's bind happens moments later; a same-port steal now
+                            node_rank=node, rpc_master=rpc_master,
+                            elastic_endpoint=(emaster.endpoint
+                                              if emaster else None))
+            if probes:
+                # release the probed ports at the last moment (rank 0's
+                # binds happen moments later; a same-port steal now
                 # needs to win a microsecond window instead of the whole
                 # env-setup span)
-                probe.close()
-                probe = None
+                for p in probes:
+                    p.close()
+                probes = []
             label = f"rank{rank}" if kind == "trainer" else f"ps{rank}"
             if args.log_dir:
                 os.makedirs(args.log_dir, exist_ok=True)
@@ -228,9 +357,18 @@ def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
                 streams.append(t)
             procs.append(proc)
 
-        # watch loop (ControllerBase.watch analog): first failure kills the pod
+        # watch loop (ControllerBase.watch analog): first failure kills
+        # the pod — after a short grace sweep so SIMULTANEOUS failures
+        # (a multi-rank host loss) are all counted before teardown and
+        # the registry's survivor set is exact. In elastic mode the
+        # loop also watches the registry for newly joined members and
+        # re-rendezvouses at the bigger world (the reference's
+        # host_call_back -> need_sync restart, manager.py:240-267,:498)
+        elastic_scan = emaster is not None and allow_scale_out
+        last_scan = time.time()
         pending = set(range(len(procs)))
         while pending:
+            failed = set()
             for i in list(pending):
                 r = procs[i].poll()
                 if r is None:
@@ -238,16 +376,30 @@ def _launch_once(args, master: str, probe, attempt: int = 0) -> int:
                 pending.discard(i)
                 if r != 0:
                     rc = r
-                    for j in pending:
-                        procs[j].send_signal(signal.SIGTERM)
-                    deadline = time.time() + 10
-                    for j in pending:
-                        try:
-                            procs[j].wait(max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            procs[j].kill()
-                    pending.clear()
-                    break
+                    failed.add(i)
+            if failed:
+                time.sleep(0.8)  # grace: catch co-dying ranks
+                for i in list(pending):
+                    r = procs[i].poll()
+                    if r is not None and r != 0:
+                        pending.discard(i)
+                        failed.add(i)
+                if emaster is not None:
+                    gone = {_member_name(i) for i in failed}
+                    for name in gone:
+                        if name is not None:
+                            emaster.leave(name)
+                _teardown(procs, pending)
+            elif (pending and elastic_scan
+                    and time.time() - last_scan >= 1.0):
+                last_scan = time.time()
+                if any(v.get("_external")
+                       for v in emaster.live().values()):
+                    sys.stderr.write(
+                        "[launch] membership grew: restarting for "
+                        "scale-out\n")
+                    rc = SCALE_OUT_RC
+                    _teardown(procs, pending)
             time.sleep(0.2)
     except BaseException:
         for p in procs:

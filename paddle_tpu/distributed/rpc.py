@@ -93,8 +93,14 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
         if world_size is None else world_size
     if master_endpoint is None:
-        # default: collective master's port + 1 (the PADDLE_MASTER port
-        # itself is owned by jax's coordination service)
+        # preferred: the launcher/spawn-probed job-private endpoint
+        # (PADDLE_RPC_MASTER) — guaranteed collision-free across
+        # concurrent jobs. Fallback: collective master's port + 1 (the
+        # PADDLE_MASTER port itself is owned by jax's coordination
+        # service) for explicit-master multi-host launches, where the
+        # convention must be computable on every host.
+        master_endpoint = os.environ.get("PADDLE_RPC_MASTER")
+    if master_endpoint is None:
         ip, port = os.environ.get("PADDLE_MASTER",
                                   "127.0.0.1:29339").split(":")
         master_endpoint = f"{ip}:{int(port) + 1}"

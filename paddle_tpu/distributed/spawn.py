@@ -14,17 +14,31 @@ import os
 import socket
 import traceback
 
-__all__ = ["spawn"]
+__all__ = ["spawn", "probe_free_port"]
+
+
+def probe_free_port(host="127.0.0.1"):
+    """Bind an OS-assigned port with SO_REUSEADDR and HOLD the socket
+    (caller closes just before the real binder starts, shrinking the
+    steal window to microseconds). Returns (socket, "host:port")."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    return s, f"{host}:{s.getsockname()[1]}"
 
 
 def rank_env_overrides(rank, nprocs, master, backend=None,
-                       devices_per_proc=1, nservers=0, server_rank=None):
+                       devices_per_proc=1, nservers=0, server_rank=None,
+                       rpc_master=None):
     """The collective env contract for one rank, as an overrides dict
     (value None = unset). SHARED by dist.spawn and the launcher CLI —
     the single definition of PADDLE_*/MASTER_*/backend env.
     server_rank is not None => a PS server process (TRAINING_ROLE=
     PSERVER): servers join the rpc world but never the device
-    collective, so they are pinned to the CPU backend."""
+    collective, so they are pinned to the CPU backend.
+    rpc_master, when given, is a job-private probed-free endpoint for
+    the rpc rendezvous — without it init_rpc falls back to coordinator
+    port + 1, which collides when jobs run concurrently."""
     if server_rank is not None:
         env = {
             "TRAINING_ROLE": "PSERVER",
@@ -36,6 +50,9 @@ def rank_env_overrides(rank, nprocs, master, backend=None,
             "JAX_PLATFORMS": "cpu",
             "PALLAS_AXON_POOL_IPS": None,
         }
+        # None UNSETS a stale endpoint inherited from an enclosing job
+        # so init_rpc falls back to the explicit-master convention
+        env["PADDLE_RPC_MASTER"] = rpc_master or None
         env["MASTER_ADDR"], env["MASTER_PORT"] = master.split(":")
         return env
     env = {
@@ -43,6 +60,7 @@ def rank_env_overrides(rank, nprocs, master, backend=None,
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(nprocs),
         "PADDLE_MASTER": master,
+        "PADDLE_RPC_MASTER": rpc_master or None,
     }
     if nservers:
         env["PADDLE_PSERVER_NUM"] = str(nservers)
@@ -79,21 +97,23 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, backend=None,
     ctx = mp.get_context("spawn")
     err_q = ctx.Queue()
 
-    probe = socket.socket()
-    probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    probe.bind(("127.0.0.1", 0))
-    master = f"127.0.0.1:{probe.getsockname()[1]}"
+    probe, master = probe_free_port()
+    # second probed-free port for the rpc rendezvous: job-private, so
+    # concurrent jobs never collide on the old coordinator+1 default
+    rpc_probe, rpc_master = probe_free_port()
 
     procs = []
     for rank in range(nprocs):
         if rank == 0:
             probe.close()  # release just before rank 0 can bind it
+            rpc_probe.close()
         # the rank env must be live in the PARENT at start(): the spawn
         # child inherits it at exec, BEFORE any sitecustomize (e.g. a
         # TPU plugin's) imports jax — in-child os.environ writes would
         # come too late to steer backend selection
         overrides = rank_env_overrides(rank, nprocs, master, backend,
-                                       devices_per_proc)
+                                       devices_per_proc,
+                                       rpc_master=rpc_master)
         saved = {k: os.environ.get(k) for k in overrides}
         try:
             for k, v in overrides.items():

@@ -1,10 +1,10 @@
 """Elastic scale-in worker: trains a counter with per-step collectives,
-checkpoints every step, and SIGKILLs the last rank at step 5 on the
-first attempt. On the scaled-in relaunch (one fewer rank) every
-survivor resumes from the checkpoint and finishes.
+checkpoints every step, and SIGKILLs the last n_kill ranks at step 5 on
+the first attempt. On the scaled-in relaunch (with the survivor count)
+every survivor resumes from the checkpoint and finishes.
 
-Usage (via launch --nprocs 3 --elastic-min 2 --max-restarts 1):
-    elastic_worker.py <ckpt.json> <kill_sentinel>
+Usage (via launch --nprocs N --elastic-min M --max-restarts 1):
+    elastic_worker.py <ckpt.json> <kill_sentinel> [n_kill=1]
 """
 import json
 import os
@@ -16,6 +16,7 @@ import numpy as np
 
 def main():
     ckpt_path, sentinel = sys.argv[1], sys.argv[2]
+    n_kill = int(sys.argv[3]) if len(sys.argv) > 3 else 1
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
@@ -38,11 +39,15 @@ def main():
             with open(tmp, "w") as f:
                 json.dump({"step": step + 1, "world": world}, f)
             os.replace(tmp, ckpt_path)
+        # snapshot BEFORE the barrier: the sentinel is written after it,
+        # so every doomed rank reads the same first-attempt verdict
+        first_attempt = not os.path.exists(sentinel)
         dist.barrier()  # the checkpoint is visible before anyone dies
-        if (step == 5 and rank == world - 1
-                and not os.path.exists(sentinel)):
-            open(sentinel, "w").close()
-            print("KILLING self (simulated host loss)", flush=True)
+        if step == 5 and rank >= world - n_kill and first_attempt:
+            if rank == world - 1:  # one sentinel write is enough
+                open(sentinel, "w").close()
+            print(f"KILLING self rank={rank} (simulated host loss)",
+                  flush=True)
             os.kill(os.getpid(), signal.SIGKILL)
 
     print(f"ELASTIC_DONE rank={rank} world={world} resumed_from={start}",

@@ -115,3 +115,83 @@ def test_elastic_scale_in_resumes_from_checkpoint(tmp_path):
     with open(ckpt) as f:
         final = json.load(f)
     assert final == {"step": 10, "world": 2}
+
+
+def test_elastic_master_membership_leases():
+    """Unit: the KV registry's TTL leases (manager.py:254-267 analog) —
+    an unheartbeated external member expires, a heartbeated one stays,
+    clear_owned drops only launcher-owned members."""
+    import time
+
+    from paddle_tpu.distributed.launch.elastic import (
+        ElasticAgent, ElasticClient, ElasticMaster,
+    )
+
+    m = ElasticMaster()
+    try:
+        c = ElasticClient(m.endpoint)
+        c.register("ghost", ttl=0.4)          # never heartbeats
+        agent = ElasticAgent(m.endpoint, "alive", ttl=0.4)
+        m.register("rank0")                    # launcher-owned
+        time.sleep(1.0)
+        live = m.live()
+        assert "ghost" not in live             # lease expired
+        assert "alive" in live                 # heartbeats refresh it
+        assert live["alive"]["_external"] is True
+        assert live["rank0"]["_external"] is False
+        m.clear_owned()
+        live = m.live()
+        assert "rank0" not in live and "alive" in live
+        agent.stop()
+        assert "alive" not in m.live()         # leave on stop
+    finally:
+        m.close()
+
+
+def test_elastic_true_survivor_count_two_rank_loss(tmp_path):
+    """VERDICT r4 next #1 (scale-in): SIGKILL 2 of 4 ranks at once ->
+    the relaunch uses the ACTUAL survivor count (nprocs=2, not 4-1=3)
+    and the survivors resume from the checkpoint."""
+    worker = os.path.join(REPO, "tests", "elastic_worker.py")
+    ckpt = str(tmp_path / "ckpt.json")
+    sentinel = str(tmp_path / "killed")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nprocs", "4", "--elastic-min", "2", "--max-restarts", "1",
+         "--backend", "cpu", worker, ckpt, sentinel, "2"],
+        env=_env(), capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert "scale-in: relaunching with 2 ranks" in res.stderr, res.stderr
+    done = [l for l in res.stdout.splitlines() if "ELASTIC_DONE" in l]
+    assert len(done) == 2, res.stdout
+    for line in done:
+        assert "world=2" in line and "resumed_from=6" in line, line
+    with open(ckpt) as f:
+        assert json.load(f) == {"step": 10, "world": 2}
+
+
+def test_elastic_rejoin_scale_out(tmp_path):
+    """VERDICT r4 next #1 (scale-out): after the 2-rank loss scales the
+    pod in to 2, a recovered host registers with the membership master
+    and the next restart boundary runs at nprocs=3."""
+    worker = os.path.join(REPO, "tests", "elastic_scaleout_worker.py")
+    ckpt = str(tmp_path / "ckpt.json")
+    sentinel = str(tmp_path / "killed")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nprocs", "4", "--elastic-min", "2", "--max-restarts", "2",
+         "--backend", "cpu", worker, ckpt, sentinel],
+        env=_env(), capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, \
+        f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+    assert "scale-in: relaunching with 2 ranks" in res.stderr, res.stderr
+    assert "membership grew: restarting for scale-out" in res.stderr, \
+        res.stderr
+    assert "scale-out: relaunching with 3 ranks" in res.stderr, res.stderr
+    done = [l for l in res.stdout.splitlines() if "ELASTIC_DONE" in l]
+    assert len(done) == 3, res.stdout
+    for line in done:
+        assert "world=3" in line and "resumed_from=8" in line, line
+    with open(ckpt) as f:
+        assert json.load(f) == {"step": 10, "world": 3}

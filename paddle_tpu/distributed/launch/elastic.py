@@ -90,8 +90,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 resp = {"ok": True}
             elif cmd == "live":
                 master._prune(now)
+                # same shape as ElasticMaster.live(): _external marks
+                # TTL-leased joiners vs launcher-owned members
                 resp = {"ok": True, "members": {
-                    k: v["info"] for k, v in master._members.items()}}
+                    k: dict(v["info"], _external=v["ttl"] is not None)
+                    for k, v in master._members.items()}}
             elif cmd == "put":
                 master._kv[req["key"]] = req.get("value")
                 resp = {"ok": True}

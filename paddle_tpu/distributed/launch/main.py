@@ -378,13 +378,16 @@ def _launch_once(args, master: str, probes, attempt: int = 0,
                     rc = r
                     failed.add(i)
             if failed:
-                time.sleep(0.8)  # grace: catch co-dying ranks
-                for i in list(pending):
-                    r = procs[i].poll()
-                    if r is not None and r != 0:
-                        pending.discard(i)
-                        failed.add(i)
                 if emaster is not None:
+                    # grace: catch co-dying ranks so the survivor set
+                    # is exact; pointless without a registry, where the
+                    # fail-fast teardown shouldn't pay 0.8s
+                    time.sleep(0.8)
+                    for i in list(pending):
+                        r = procs[i].poll()
+                        if r is not None and r != 0:
+                            pending.discard(i)
+                            failed.add(i)
                     gone = {_member_name(i) for i in failed}
                     for name in gone:
                         if name is not None:

@@ -92,19 +92,16 @@ class Config:
         self._micro_batch_size = int(n)
 
     def set_dist_degrees(self, dp: int = 1, mp: int = 1):
-        """Serve the loaded artifact dp-way data-parallel on the local
-        mesh: the deserialized exported program is called inside an
-        outer pjit whose batch inputs are 'dp'-sharded — XLA's SPMD
-        partitioner re-partitions the single-device program
-        (dist_model.cc resharding analog). mp>1 needs layer-level
-        dist_specs, which a saved artifact no longer has — build a
-        DistModel from the live nn.Layer for that."""
-        if int(mp) != 1:
-            raise NotImplementedError(
-                "mp>1 over a saved artifact: weight shardings are not "
-                "recorded in the exported program; serve from the "
-                "layer: DistModel(DistModelConfig(layer=..., mp=...))")
+        """Serve the loaded artifact dp x mp on the local mesh: the
+        deserialized exported program is called inside an outer pjit
+        whose batch inputs are 'dp'-sharded and whose weights are laid
+        out by the dist_specs RECORDED AT SAVE TIME (jit.save stores
+        each weight's layer-level PartitionSpec, e.g.
+        ColumnParallelLinear's P(None, 'mp')); XLA's SPMD partitioner
+        then re-partitions the single-device program — the
+        dist_model.cc multi-rank-serving analog."""
         self._dp = int(dp)
+        self._mp = int(mp)
 
     # no-op knobs kept for reference-API parity (GPU/IR notions)
     def disable_gpu(self):
@@ -117,25 +114,63 @@ class Config:
         pass
 
 
-def _shard_translated(tl, dp):
-    """Wrap a loaded TranslatedLayer's exported program for dp-way
-    serving: weights replicate, batch inputs shard over a ('dp',) mesh,
-    and the outer jit lets XLA SPMD re-partition the single-device
-    program. Returns (run_fwd, dp)."""
+def _shard_translated(tl, dp, mp=1):
+    """Wrap a loaded TranslatedLayer's exported program for dp x mp
+    serving: batch inputs shard over 'dp', weights are placed by the
+    dist_spec recorded per weight at save time (replicated when none —
+    so plain dp serving is the mp=1 special case), and the outer jit
+    lets XLA SPMD re-partition the single-device program
+    (dist_model.cc resharding analog)."""
     import jax
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    from paddle_tpu.jit.save_load import spec_from_json
     from paddle_tpu.ops.dispatch import unwrap
 
     devs = jax.devices()
-    if dp > len(devs):
-        raise ValueError(f"dp={dp} exceeds {len(devs)} devices")
-    mesh = Mesh(np.array(devs[:dp]), ("dp",))
-    repl = NamedSharding(mesh, P())
+    if dp * mp > len(devs):
+        raise ValueError(f"dp*mp={dp * mp} exceeds {len(devs)} devices")
+    mesh = Mesh(np.array(devs[:dp * mp]).reshape(dp, mp), ("dp", "mp"))
+    specs = tl._meta.get("state_dist_specs") or [None] * len(tl._state_args)
+    if mp > 1 and not any(specs):
+        names = tl._meta.get("state_names", [])
+        raise ValueError(
+            "mp>1 serving needs weight dist_specs in the artifact, but "
+            f"none were recorded ({len(names)} weights, all replicated) "
+            "— save a model whose layers carry mp shardings "
+            "(ColumnParallelLinear/RowParallelLinear/"
+            "VocabParallelEmbedding) with this version's jit.save")
+    def usable(sj):
+        """Recorded spec restricted to THIS mesh's axes: a weight
+        sharded over an axis the serving mesh doesn't model (MoE 'ep',
+        pipeline 'pp') is served replicated along that dim — dp/mp
+        serving of such artifacts keeps working."""
+        if sj is None:
+            return P()
+        axes = {"dp", "mp"}
+
+        def dim(e):
+            if isinstance(e, list):
+                kept = [x for x in e if x in axes]
+                return tuple(kept) if kept else None
+            return e if e in axes else None
+
+        return spec_from_json([dim(e) for e in sj])
+
+    state_args = []
+    for a, sj, name in zip(
+            tl._state_args, specs,
+            tl._meta.get("state_names", [None] * len(tl._state_args))):
+        spec = usable(sj)
+        try:
+            state_args.append(jax.device_put(
+                np.asarray(a), NamedSharding(mesh, spec)))
+        except ValueError as e:
+            raise ValueError(
+                f"weight {name!r} {np.asarray(a).shape} cannot be laid "
+                f"out as {spec} on a dp={dp} x mp={mp} mesh ({e})") from e
     bs = NamedSharding(mesh, P("dp"))
-    state_args = [jax.device_put(np.asarray(a), repl)
-                  for a in tl._state_args]
     exported = tl._exported
 
     @jax.jit
@@ -169,11 +204,12 @@ class Predictor:
                 "with paddle.jit.save(layer, path, input_spec=[...], "
                 "convert='bfloat16')")
         self._forward = self._layer
-        if config._dp > 1:
+        if config._dp > 1 or config._mp > 1:
             if self._layer._exported is None:
                 raise ValueError("set_dist_degrees needs an executable "
                                  "artifact (saved with input_spec)")
-            self._forward = _shard_translated(self._layer, config._dp)
+            self._forward = _shard_translated(self._layer, config._dp,
+                                              config._mp)
 
     def get_input_names(self):
         spec = self._layer.input_spec or []
@@ -243,14 +279,12 @@ class DistModel:
             from paddle_tpu.jit.save_load import load
 
             self._translated = load(cfg.model_path)
-            if cfg.mp != 1:
-                raise NotImplementedError(
-                    "mp>1 over a saved artifact (no recorded weight "
-                    "shardings); serve from the live layer instead")
-            if cfg.dp > 1 and self._translated._exported is not None:
-                # saved on 1 device, served dp-way: outer pjit reshards
+            if (cfg.dp > 1 or cfg.mp > 1) \
+                    and self._translated._exported is not None:
+                # saved on 1 device, served dp x mp: the outer pjit
+                # reshards using the artifact's recorded dist_specs
                 self._forward = _shard_translated(self._translated,
-                                                  cfg.dp)
+                                                  cfg.dp, cfg.mp)
             else:
                 self._forward = self._run_translated
         else:

@@ -33,6 +33,23 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 
 
+def _spec_to_json(spec):
+    """PartitionSpec -> JSON list (None | str | [str,...] per dim)."""
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, (tuple, list)) else e
+            for e in tuple(spec)]
+
+
+def spec_from_json(entry):
+    """Inverse of _spec_to_json; None stays None (= replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    if entry is None:
+        return None
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entry])
+
+
 def _export_platforms():
     """Always export for cpu AND tpu: the artifact must be loadable on a
     TPU serving host even when saved from a CPU-only process (and vice
@@ -158,6 +175,14 @@ def save(layer, path, input_spec=None, convert=None, **configs):
              "name": getattr(s, "name", None)} for s in input_spec
         ]
         meta["state_names"] = state_names
+        # layer-level weight shardings (mp layers set Tensor.dist_spec,
+        # e.g. ColumnParallelLinear -> P(None, 'mp')): recorded so a
+        # saved artifact can be served tensor-parallel
+        # (inference.Config.set_dist_degrees(dp, mp) — the
+        # dist_model.cc multi-rank serving analog)
+        meta["state_dist_specs"] = [
+            _spec_to_json(getattr(t, "dist_spec", None))
+            for t in all_state]
         meta["has_mlir"] = True
         meta["platforms"] = _export_platforms()
 

@@ -177,10 +177,12 @@ def test_saved_artifact_serves_dp_sharded(tmp_path):
     sharded = pred.run([x])[0]
     np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6)
 
-    # mp over a saved artifact still refuses, with guidance
+    # mp over an artifact with NO recorded weight shardings refuses
+    # with guidance (plain Linear layers carry no dist_spec)
     cfg_mp = infer.Config(path)
-    with pytest.raises(NotImplementedError):
-        cfg_mp.set_dist_degrees(dp=1, mp=2)
+    cfg_mp.set_dist_degrees(dp=1, mp=2)
+    with pytest.raises(ValueError, match="dist_specs"):
+        infer.create_predictor(cfg_mp)
 
     # ragged batch: pad_to=dp trims back to the true rows
     x5 = x[:5]
@@ -208,3 +210,91 @@ def test_distmodel_from_saved_path_dp(tmp_path):
     dm.init()
     got = dm.run([x])[0]
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_saved_artifact_serves_mp_sharded(tmp_path):
+    """VERDICT r4 missing #3: save an mp-layered model on ONE device,
+    serve it dp=2 x mp=2 on the 8-CPU mesh — jit.save records each
+    weight's dist_spec (ColumnParallelLinear P(None,'mp'),
+    RowParallelLinear P('mp',None)) and the serving pjit lays the
+    weights out tensor-parallel; outputs match single-device serving."""
+    import paddle_tpu as paddle
+    import paddle_tpu.inference as infer
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear,
+    )
+    from paddle_tpu.jit.api import InputSpec
+
+    paddle.seed(1)
+
+    class MpNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = ColumnParallelLinear(6, 16, gather_output=False)
+            self.row = RowParallelLinear(16, 4, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(paddle.tanh(self.col(x)))
+
+    net = MpNet()
+    net.eval()
+    path = str(tmp_path / "mp_model")
+    jit.save(net, path, input_spec=[InputSpec([8, 6], "float32")])
+
+    # the artifact recorded the layer-level shardings
+    import json as _json
+
+    with open(path + ".json") as f:
+        meta = _json.load(f)
+    assert [None, "mp"] in meta["state_dist_specs"]  # column weight
+    assert ["mp", None] in meta["state_dist_specs"]  # row weight
+
+    x = np.random.RandomState(1).randn(8, 6).astype(np.float32)
+    plain = infer.create_predictor(infer.Config(path)).run([x])[0]
+
+    cfg = infer.Config(path)
+    cfg.set_dist_degrees(dp=2, mp=2)
+    pred = infer.create_predictor(cfg)
+    sharded = pred.run([x])[0]
+    np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6)
+
+    # DistModel over the same artifact, same layout
+    dm = infer.DistModel(infer.DistModelConfig(model_path=path, dp=2,
+                                               mp=2)).init()
+    np.testing.assert_allclose(dm.run([x])[0], plain, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_foreign_axis_dist_specs_serve_replicated(tmp_path):
+    """A weight sharded over an axis the serving mesh doesn't model
+    (e.g. MoE 'ep') is served replicated along that dim instead of
+    crashing predictor construction — dp serving of re-saved MoE
+    artifacts keeps working."""
+    import json as _json
+
+    import paddle_tpu as paddle
+    import paddle_tpu.inference as infer
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.api import InputSpec
+
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    # simulate an expert-parallel weight annotation
+    from jax.sharding import PartitionSpec as P
+
+    net[0].weight.dist_spec = P("ep", None)
+    path = str(tmp_path / "ep_model")
+    jit.save(net, path, input_spec=[InputSpec([8, 4], "float32")])
+    with open(path + ".json") as f:
+        assert ["ep", None] in _json.load(f)["state_dist_specs"]
+
+    x = np.random.RandomState(7).randn(8, 4).astype(np.float32)
+    plain = infer.create_predictor(infer.Config(path)).run([x])[0]
+    cfg = infer.Config(path)
+    cfg.set_dist_degrees(dp=2)
+    out = infer.create_predictor(cfg).run([x])[0]
+    np.testing.assert_allclose(out, plain, rtol=1e-5, atol=1e-6)

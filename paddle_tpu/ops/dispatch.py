@@ -28,15 +28,45 @@ __all__ = ["apply", "apply_nograd", "as_tensor", "unwrap", "OpStats"]
 
 
 class OpStats:
-    """Per-op dispatch counters (profiler hook point)."""
+    """Per-op dispatch counters (profiler hook point).
+
+    span_hook, when set by the Profiler, receives
+    (name, start_us, end_us, synced) for every eager op dispatch —
+    synced=True means the dispatch blocked until outputs were ready
+    (ProfilerTarget.TPU sync timing: the span approximates
+    host-dispatch + device-execute, the CUPTI-attribution analog)."""
 
     counts: dict = {}
     enabled = False
+    span_hook = None
+    sync_spans = False
 
     @classmethod
     def record(cls, name):
         if cls.enabled:
             cls.counts[name] = cls.counts.get(name, 0) + 1
+
+
+def _timed_dispatch(name, run):
+    """Wrap one op dispatch with the profiler span hook (no-op fast
+    path when no profiler is recording)."""
+    hook = OpStats.span_hook
+    if hook is None:
+        return run()
+    import time as _time
+
+    t0 = _time.perf_counter_ns() // 1000
+    out = run()
+    synced = False
+    if OpStats.sync_spans:
+        try:
+            jax.block_until_ready([o._array for o in out] if
+                                  isinstance(out, tuple) else out._array)
+            synced = True
+        except Exception:
+            pass  # tracers / non-tensor outputs: host span only
+    hook(name, t0, _time.perf_counter_ns() // 1000, synced)
+    return out
 
 
 def _maybe_check_numerics(op_name, arrays):
@@ -102,6 +132,15 @@ def apply(name: str, fn: Callable, *inputs: Tensor, amp_policy: str = None):
     `fn` must be a pure function of the input arrays (static attrs go in
     the closure). Returns Tensor or tuple of Tensors.
     """
+    if OpStats.span_hook is not None:
+        return _timed_dispatch(
+            name, lambda: _apply_impl(name, fn, *inputs,
+                                      amp_policy=amp_policy))
+    return _apply_impl(name, fn, *inputs, amp_policy=amp_policy)
+
+
+def _apply_impl(name: str, fn: Callable, *inputs: Tensor,
+                amp_policy: str = None):
     OpStats.record(name)
     from paddle_tpu.amp.auto_cast import maybe_autocast  # lazy; amp optional
 
@@ -143,10 +182,13 @@ def apply(name: str, fn: Callable, *inputs: Tensor, amp_policy: str = None):
 
 def apply_nograd(name: str, fn: Callable, *inputs: Tensor):
     """Run a non-differentiable op (comparisons, argmax, casts to int...)."""
-    OpStats.record(name)
-    arrays = [t._array for t in inputs]
-    out = fn(*arrays)
-    return _wrap_outputs(out, None, False, op_name=name)
+    def run():
+        OpStats.record(name)
+        arrays = [t._array for t in inputs]
+        out = fn(*arrays)
+        return _wrap_outputs(out, None, False, op_name=name)
+
+    return _timed_dispatch(name, run)
 
 
 def apply_with_cpu_fallback(apply_fn: Callable, name: str, fn: Callable,

@@ -13,9 +13,11 @@ from .profiler import (
     export_chrome_tracing,
     make_scheduler,
 )
+from .profiler_statistic import SortedKeys, StatisticData
 from .utils import SummaryView
 
 __all__ = [
     "Profiler", "RecordEvent", "ProfilerState", "ProfilerTarget",
     "make_scheduler", "export_chrome_tracing", "SummaryView",
+    "SortedKeys", "StatisticData",
 ]

@@ -29,13 +29,13 @@ class _HostEventRecorder:
         self._lock = threading.Lock()
         self.enabled = False
 
-    def record(self, name, start_us, end_us, tid):
+    def record(self, name, start_us, end_us, tid, cat="host"):
         if not self.enabled:
             return
         with self._lock:
             self.events.append(
                 {"name": name, "ph": "X", "ts": start_us, "dur": end_us - start_us,
-                 "pid": os.getpid(), "tid": tid, "cat": "host"})
+                 "pid": os.getpid(), "tid": tid, "cat": cat})
 
     def drain(self):
         with self._lock:
@@ -118,6 +118,11 @@ class Profiler:
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        # ProfilerTarget.TPU => sync-timed op spans (each dispatch
+        # blocks until outputs are ready, approximating device time —
+        # the CUPTI-attribution analog; see profiler_statistic.py)
+        self._sync_ops = any(t == ProfilerTarget.TPU
+                             for t in (targets or []))
         self.step_num = 0
         self._state = ProfilerState.CLOSED
         self._events: List[dict] = []
@@ -126,22 +131,45 @@ class Profiler:
         self._export_path = None
         self._step_t0 = None
         self._step_times = []
+        self._trace_ready_fired = False
 
     # -- lifecycle ---------------------------------------------------------
+    def _set_recording(self, on: bool):
+        """Toggle the span sinks together: host RecordEvents and the
+        per-op dispatch span hook (device-sync when targets say TPU)."""
+        from paddle_tpu.ops.dispatch import OpStats
+
+        _recorder.enabled = on
+        if on and not self._timer_only:
+            OpStats.span_hook = self._op_span
+            OpStats.sync_spans = self._sync_ops
+        else:
+            OpStats.span_hook = None
+            OpStats.sync_spans = False
+
+    def _op_span(self, name, start_us, end_us, synced):
+        # op spans feed the operator summary; sync-timed ones carry
+        # device attribution (see profiler_statistic.py)
+        _recorder.record(name, start_us, end_us,
+                         threading.get_ident() % 100000,
+                         cat="device" if synced else "op")
+
     def start(self):
-        _recorder.enabled = True
         self._state = (self._scheduler(self.step_num)
                        if self._scheduler else ProfilerState.RECORD)
+        self._set_recording(self._state in (
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN))
         self._maybe_start_device_trace()
         self._step_t0 = time.perf_counter()
         return self
 
     def stop(self):
-        _recorder.enabled = False
+        self._set_recording(False)
         self._events.extend(_recorder.drain())
         self._maybe_stop_device_trace()
-        if self._on_trace_ready:
+        if self._on_trace_ready and not self._trace_ready_fired:
             self._on_trace_ready(self)
+        self._trace_ready_fired = False
         self._state = ProfilerState.CLOSED
 
     def step(self, num_frames: int = 1):
@@ -154,12 +182,15 @@ class Profiler:
             new_state = self._scheduler(self.step_num)
             if new_state != self._state:
                 if new_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
-                    _recorder.enabled = True
+                    self._set_recording(True)
+                    self._trace_ready_fired = False  # new record window
                 elif self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
                     self._events.extend(_recorder.drain())
-                    _recorder.enabled = False
+                    self._set_recording(False)
                     if new_state == ProfilerState.CLOSED and self._on_trace_ready:
+                        # fired here; stop() must not export a duplicate
                         self._on_trace_ready(self)
+                        self._trace_ready_fired = True
                 self._state = new_state
 
     def __enter__(self):
@@ -201,23 +232,24 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        from paddle_tpu.ops.dispatch import OpStats
+        """Aggregated statistics report (profiler_statistic.py analog):
+        per-name calls/total/avg/max for host spans and op dispatches,
+        with device-time attribution when targets included TPU. Prints
+        the table and returns the StatisticData for programmatic use."""
+        from .profiler_statistic import (
+            SortedKeys, StatisticData, build_table,
+        )
 
-        lines = ["---- profiler summary ----"]
-        if self._step_times:
-            import numpy as np
-
-            st = np.asarray(self._step_times[1:] or self._step_times)
-            lines.append(
-                f"steps={len(self._step_times)} mean={st.mean()*1e3:.3f}ms "
-                f"p50={np.percentile(st,50)*1e3:.3f}ms p99={np.percentile(st,99)*1e3:.3f}ms")
-        agg = {}
-        for e in self._events:
-            a = agg.setdefault(e["name"], [0, 0.0])
-            a[0] += 1
-            a[1] += e["dur"] / 1000.0
-        for name, (cnt, total) in sorted(agg.items(), key=lambda kv: -kv[1][1])[:30]:
-            lines.append(f"{name:<40} calls={cnt:<8} total={total:.3f}ms")
-        out = "\n".join(lines)
-        print(out)
-        return out
+        self._events.extend(_recorder.drain())
+        data = StatisticData(self._events, self._step_times)
+        if sorted_by is None:
+            # sync-timed profiles put all op time in the device column;
+            # sorting them by (all-zero) CPU totals would scramble the
+            # table
+            sorted_by = (SortedKeys.DeviceTotal if self._sync_ops
+                         else SortedKeys.CPUTotal)
+        table = build_table(
+            data, sorted_by=sorted_by,
+            op_detail=op_detail, time_unit=time_unit)
+        print("---- profiler summary ----\n" + table)
+        return data

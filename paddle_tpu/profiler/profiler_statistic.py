@@ -1,0 +1,173 @@
+"""Aggregated profiler statistics — analog of
+python/paddle/profiler/profiler_statistic.py: per-name event summaries
+(calls / total / avg / max / min for host time and, when sync-timed
+device spans exist, device time) sorted by a SortedKeys policy and
+rendered as an aligned summary table.
+
+The reference attributes kernel time via CUPTI
+(platform/profiler/cuda_tracer.cc); on this stack the high-fidelity
+device timeline is jax.profiler's XPlane (PADDLE_TPU_TRACE_DIR), whose
+protos aren't parseable in-process — so device columns here come from
+SYNC-TIMED op spans: when the Profiler's targets include
+ProfilerTarget.TPU, each eager op dispatch blocks until its outputs are
+ready and the span approximates host-dispatch + device-execute time.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Dict, List
+
+__all__ = ["SortedKeys", "EventItem", "StatisticData", "build_table"]
+
+
+class SortedKeys(Enum):
+    """Summary-table sort policy (reference profiler_statistic.py
+    SortedKeys; GPU* named DeviceTotal... here — TPU has no per-kernel
+    CUPTI split)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    DeviceTotal = 4
+    DeviceAvg = 5
+    DeviceMax = 6
+    DeviceMin = 7
+    # reference-name aliases
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class EventItem:
+    """Aggregate of every span sharing one name (reference
+    profiler_statistic.py EventSummary items)."""
+
+    __slots__ = ("name", "call", "cpu_time", "max_cpu_time",
+                 "min_cpu_time", "device_time", "max_device_time",
+                 "min_device_time")
+
+    def __init__(self, name):
+        self.name = name
+        self.call = 0
+        self.cpu_time = 0.0
+        self.max_cpu_time = 0.0
+        self.min_cpu_time = float("inf")
+        self.device_time = 0.0
+        self.max_device_time = 0.0
+        self.min_device_time = float("inf")
+
+    def add(self, dur_ms, device: bool):
+        self.call += 1
+        if device:
+            self.device_time += dur_ms
+            self.max_device_time = max(self.max_device_time, dur_ms)
+            self.min_device_time = min(self.min_device_time, dur_ms)
+        else:
+            self.cpu_time += dur_ms
+            self.max_cpu_time = max(self.max_cpu_time, dur_ms)
+            self.min_cpu_time = min(self.min_cpu_time, dur_ms)
+
+    @property
+    def avg_cpu_time(self):
+        n = max(1, self.call)
+        return self.cpu_time / n
+
+    @property
+    def avg_device_time(self):
+        n = max(1, self.call)
+        return self.device_time / n
+
+    def _key(self, sorted_by: SortedKeys):
+        return {
+            SortedKeys.CPUTotal: self.cpu_time,
+            SortedKeys.CPUAvg: self.avg_cpu_time,
+            SortedKeys.CPUMax: self.max_cpu_time,
+            SortedKeys.CPUMin: -(self.min_cpu_time
+                                 if self.min_cpu_time != float("inf")
+                                 else 0.0),
+            SortedKeys.DeviceTotal: self.device_time,
+            SortedKeys.DeviceAvg: self.avg_device_time,
+            SortedKeys.DeviceMax: self.max_device_time,
+            SortedKeys.DeviceMin: -(self.min_device_time
+                                    if self.min_device_time != float("inf")
+                                    else 0.0),
+        }[sorted_by]
+
+
+class StatisticData:
+    """Span list -> per-category aggregation. Categories follow the
+    span's chrome-trace 'cat': 'op' / 'device' spans feed the operator
+    summary (device=True for sync-timed 'device' spans), everything
+    else lands in the user/host summary (RecordEvent annotations)."""
+
+    def __init__(self, events: List[dict], step_times=None):
+        self.op_items: Dict[str, EventItem] = {}
+        self.user_items: Dict[str, EventItem] = {}
+        self.step_times = list(step_times or [])
+        for e in events:
+            cat = e.get("cat", "host")
+            dur_ms = e.get("dur", 0) / 1000.0
+            table = (self.op_items if cat in ("op", "device")
+                     else self.user_items)
+            table.setdefault(e["name"], EventItem(e["name"])).add(
+                dur_ms, device=(cat == "device"))
+
+    def sorted_ops(self, sorted_by: SortedKeys = SortedKeys.CPUTotal):
+        return sorted(self.op_items.values(),
+                      key=lambda it: -it._key(sorted_by))
+
+    def sorted_user(self, sorted_by: SortedKeys = SortedKeys.CPUTotal):
+        return sorted(self.user_items.values(),
+                      key=lambda it: -it._key(sorted_by))
+
+
+def _fmt(ms, unit_div, inf_ok=False):
+    if ms == float("inf"):
+        return "-" if inf_ok else "0.000"
+    return f"{ms / unit_div:.3f}"
+
+
+def build_table(data: StatisticData,
+                sorted_by: SortedKeys = SortedKeys.CPUTotal,
+                op_detail: bool = True, time_unit: str = "ms",
+                row_limit: int = 30) -> str:
+    """Render the aligned summary table (gen_layer_summary /
+    _build_table analog)."""
+    unit_div = {"s": 1000.0, "ms": 1.0, "us": 1e-3}.get(time_unit, 1.0)
+    lines = []
+    if data.step_times:
+        import numpy as np
+
+        st = np.asarray(data.step_times[1:] or data.step_times) * 1e3
+        lines.append(
+            f"steps={len(data.step_times)} "
+            f"mean={_fmt(st.mean(), unit_div)}{time_unit} "
+            f"p50={_fmt(float(np.percentile(st, 50)), unit_div)}{time_unit} "
+            f"p99={_fmt(float(np.percentile(st, 99)), unit_div)}{time_unit}")
+
+    def section(title, items):
+        if not items:
+            return
+        w = max(12, min(44, max(len(i.name) for i in items) + 2))
+        hdr = (f"{'Name':<{w}} {'Calls':>7} "
+               f"{'CPU Total':>11} {'CPU Avg':>9} {'CPU Max':>9} "
+               f"{'Dev Total':>11} {'Dev Avg':>9}")
+        lines.append("-" * len(hdr))
+        lines.append(f"[{title}]  (times in {time_unit}, "
+                     f"sorted by {sorted_by.name})")
+        lines.append(hdr)
+        for it in items[:row_limit]:
+            lines.append(
+                f"{it.name[:w]:<{w}} {it.call:>7} "
+                f"{_fmt(it.cpu_time, unit_div):>11} "
+                f"{_fmt(it.avg_cpu_time, unit_div):>9} "
+                f"{_fmt(it.max_cpu_time, unit_div):>9} "
+                f"{_fmt(it.device_time, unit_div):>11} "
+                f"{_fmt(it.avg_device_time, unit_div):>9}")
+
+    section("UserDefined / host spans", data.sorted_user(sorted_by))
+    if op_detail:
+        section("Operator summary", data.sorted_ops(sorted_by))
+    return "\n".join(lines) if lines else "(no profiler events)"

@@ -1,0 +1,134 @@
+"""Profiler scheduler + aggregated statistics (VERDICT r4 missing #4):
+make_scheduler drives CLOSED/READY/RECORD cycling across steps, and
+summary() aggregates spans per name with calls/total/avg/max plus
+device-time attribution from sync-timed op spans.
+
+Reference: python/paddle/profiler/profiler.py:344 (scheduler states),
+profiler_statistic.py (summary tables, SortedKeys).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import ProfilerState, SortedKeys
+
+
+def test_make_scheduler_state_cycle():
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                    repeat=2, skip_first=1)
+    want = [ProfilerState.CLOSED,             # skip_first
+            ProfilerState.CLOSED, ProfilerState.READY,
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED, ProfilerState.READY,
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED, ProfilerState.CLOSED]  # repeat done
+    assert [sched(i) for i in range(len(want))] == want
+
+
+def test_scheduler_gates_recording_across_steps():
+    """Only the RECORD windows of the cycle collect op spans."""
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                    repeat=1)
+    prof = profiler.Profiler(scheduler=sched)
+    prof.start()
+    per_step_ops = []
+    for step in range(5):
+        before = _op_event_count(prof)
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        (x * 2 + 1).sum()
+        prof.step()
+        per_step_ops.append(_op_event_count(prof) - before)
+    prof.stop()
+    # steps 0 (CLOSED) and 1 (READY) record nothing; steps 2-3 RECORD
+    assert per_step_ops[0] == 0 and per_step_ops[1] == 0
+    assert per_step_ops[2] > 0 and per_step_ops[3] > 0
+    assert per_step_ops[4] == 0  # cycle exhausted (repeat=1)
+
+
+def _op_event_count(prof):
+    from paddle_tpu.profiler.profiler import _recorder
+
+    return sum(1 for e in prof._events + _recorder.events
+               if e.get("cat") in ("op", "device"))
+
+
+def test_summary_aggregates_ops_with_stats(capsys):
+    prof = profiler.Profiler()
+    prof.start()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(8, 8).astype(np.float32))
+    with profiler.RecordEvent("user_block"):
+        for _ in range(3):
+            y = paddle.matmul(x, x)
+    _ = y.numpy()
+    prof.stop()
+    data = prof.summary()
+    printed = capsys.readouterr().out
+    # per-op aggregation with counts
+    assert "matmul" in data.op_items
+    it = data.op_items["matmul"]
+    assert it.call == 3
+    assert it.cpu_time >= it.max_cpu_time > 0
+    assert abs(it.avg_cpu_time - it.cpu_time / 3) < 1e-9
+    # user annotation lands in its own section
+    assert "user_block" in data.user_items
+    assert "Operator summary" in printed and "Calls" in printed
+
+
+def test_summary_device_attribution_with_tpu_target():
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.CPU,
+                                      profiler.ProfilerTarget.TPU])
+    prof.start()
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(16, 16).astype(np.float32))
+    for _ in range(2):
+        x = paddle.tanh(x)
+    prof.stop()
+    data = prof.summary(sorted_by=SortedKeys.DeviceTotal)
+    it = data.op_items["tanh"]
+    assert it.call == 2
+    assert it.device_time > 0          # sync-timed spans
+    assert it.cpu_time == 0            # all attribution is device-side
+
+
+def test_sorted_keys_order():
+    from paddle_tpu.profiler.profiler_statistic import (
+        EventItem, StatisticData,
+    )
+
+    events = [
+        {"name": "a", "dur": 1000, "cat": "op"},
+        {"name": "b", "dur": 5000, "cat": "op"},
+        {"name": "b", "dur": 100, "cat": "op"},
+    ]
+    data = StatisticData(events)
+    by_total = [i.name for i in data.sorted_ops(SortedKeys.CPUTotal)]
+    assert by_total == ["b", "a"]      # 5.1ms vs 1ms
+    by_max = [i.name for i in data.sorted_ops(SortedKeys.CPUMax)]
+    assert by_max == ["b", "a"]
+    by_min = [i.name for i in data.sorted_ops(SortedKeys.CPUMin)]
+    assert by_min == ["b", "a"]        # min 0.1ms sorts ascending-first
+
+
+def test_span_hook_removed_after_stop():
+    from paddle_tpu.ops.dispatch import OpStats
+
+    prof = profiler.Profiler()
+    prof.start()
+    assert OpStats.span_hook is not None
+    prof.stop()
+    assert OpStats.span_hook is None and OpStats.sync_spans is False
+
+
+def test_on_trace_ready_fires_once_per_cycle(tmp_path):
+    fired = []
+    sched = profiler.make_scheduler(closed=0, ready=0, record=2,
+                                    repeat=1)
+    prof = profiler.Profiler(scheduler=sched,
+                             on_trace_ready=lambda p: fired.append(1))
+    prof.start()
+    for _ in range(3):
+        paddle.to_tensor(np.ones(2, np.float32)).sum()
+        prof.step()
+    prof.stop()  # handler already ran when the cycle closed
+    assert len(fired) == 1

@@ -59,12 +59,25 @@ def _timed_dispatch(name, run):
     out = run()
     synced = False
     if OpStats.sync_spans:
-        try:
-            jax.block_until_ready([o._array for o in out] if
-                                  isinstance(out, tuple) else out._array)
-            synced = True
-        except Exception:
-            pass  # tracers / non-tensor outputs: host span only
+        arrs = [o._array for o in out] if isinstance(out, tuple) \
+            else [out._array]
+        # block_until_ready is a no-op on tracers (it does NOT raise),
+        # so trace-time dispatches must be tagged host-side explicitly
+        # or the device column absorbs tracing/compile time
+        if not any(isinstance(a, jax.core.Tracer) for a in arrs):
+            try:
+                jax.block_until_ready(arrs)
+                if jax.default_backend() == "axon":
+                    # the axon tunnel's block_until_ready can return
+                    # early; a 1-element readback forces completion
+                    # (this is what makes sync profiling cost a tunnel
+                    # round-trip per op — documented trade-off)
+                    import numpy as _np
+
+                    _np.asarray(arrs[0].ravel()[:1])
+                synced = True
+            except Exception:
+                pass  # non-array outputs: host span only
     hook(name, t0, _time.perf_counter_ns() // 1000, synced)
     return out
 
@@ -182,13 +195,17 @@ def _apply_impl(name: str, fn: Callable, *inputs: Tensor,
 
 def apply_nograd(name: str, fn: Callable, *inputs: Tensor):
     """Run a non-differentiable op (comparisons, argmax, casts to int...)."""
-    def run():
-        OpStats.record(name)
-        arrays = [t._array for t in inputs]
-        out = fn(*arrays)
-        return _wrap_outputs(out, None, False, op_name=name)
+    if OpStats.span_hook is not None:
+        return _timed_dispatch(
+            name, lambda: _apply_nograd_impl(name, fn, *inputs))
+    return _apply_nograd_impl(name, fn, *inputs)
 
-    return _timed_dispatch(name, run)
+
+def _apply_nograd_impl(name: str, fn: Callable, *inputs: Tensor):
+    OpStats.record(name)
+    arrays = [t._array for t in inputs]
+    out = fn(*arrays)
+    return _wrap_outputs(out, None, False, op_name=name)
 
 
 def apply_with_cpu_fallback(apply_fn: Callable, name: str, fn: Callable,

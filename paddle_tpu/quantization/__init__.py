@@ -19,8 +19,11 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.ops.dispatch import apply
 
 __all__ = ["quantize_absmax", "dequantize", "fake_quant",
-           "AbsmaxObserver", "FakeQuanterWithAbsMaxObserver",
-           "QuantConfig", "QAT", "PTQ", "QuantedLinear"]
+           "AbsmaxObserver", "PerChannelAbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterChannelWiseAbsMax",
+           "QuantConfig", "QAT", "PTQ", "QuantedLinear",
+           "QuantedConv2D", "QATLinear", "QATConv2D", "convert"]
 
 
 def quantize_absmax(w, bits=8, axis=None):
@@ -81,6 +84,81 @@ class AbsmaxObserver(nn.Layer):
         return max(self._absmax, 1e-8) / qmax
 
 
+class PerChannelAbsmaxObserver(nn.Layer):
+    """Per-channel PTQ observer (reference observers with quant_axis):
+    tracks max |x| per channel along `channel_axis`."""
+
+    def __init__(self, quant_bits=8, channel_axis=0):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.channel_axis = channel_axis
+        self._absmax = None
+
+    def forward(self, x):
+        arr = x._array
+        red = tuple(i for i in range(arr.ndim) if i != self.channel_axis)
+        cur = np.asarray(jnp.max(jnp.abs(arr), axis=red))
+        self._absmax = cur if self._absmax is None \
+            else np.maximum(self._absmax, cur)
+        return x
+
+    def scale(self):
+        """Per-channel scale vector (shape [n_channels]); None before
+        any observation (convert then skips activation quant, like the
+        other observers)."""
+        if self._absmax is None:
+            return None
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        return np.maximum(self._absmax, 1e-8) / qmax
+
+
+class FakeQuanterChannelWiseAbsMax(nn.Layer):
+    """Per-channel QAT weight quanter (reference
+    quanters FakeQuanterChannelWiseAbsMaxObserver): per-channel absmax
+    scale along `channel_axis` + STE fake-quant. channel_axis=None lets
+    the wrapping QAT layer pick the layer-appropriate axis (Linear out
+    dim 1, Conv2D out dim 0)."""
+
+    def __init__(self, quant_bits=8, channel_axis=None, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.channel_axis = channel_axis
+        self.moving_rate = moving_rate
+        self._absmax = None
+
+    def forward(self, x):
+        import jax
+
+        if self.channel_axis is None:
+            raise ValueError(
+                "FakeQuanterChannelWiseAbsMax needs a channel_axis: as "
+                "a weight quanter the QAT wrapper sets it (Linear=1, "
+                "Conv2D=0); as an activation quanter pass it explicitly "
+                "(axis 0 would be the BATCH dim — per-sample scales)")
+        axis = self.channel_axis
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        arr = x._array
+        red = tuple(i for i in range(arr.ndim) if i != axis)
+        if not isinstance(arr, jax.core.Tracer):
+            cur = np.asarray(jnp.max(jnp.abs(arr), axis=red))
+            self._absmax = cur if self._absmax is None else \
+                self.moving_rate * self._absmax + \
+                (1 - self.moving_rate) * cur
+        absmax = self._absmax if self._absmax is not None \
+            else np.ones(arr.shape[axis], np.float32)
+        scale = np.maximum(absmax, 1e-8) / qmax
+        shape = [1] * arr.ndim
+        shape[axis] = -1
+        return fake_quant(x, jnp.asarray(scale, jnp.float32).reshape(shape),
+                          self.quant_bits)
+
+    def scale(self):
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        if self._absmax is None:
+            return None
+        return np.maximum(self._absmax, 1e-8) / qmax
+
+
 class FakeQuanterWithAbsMaxObserver(nn.Layer):
     """QAT quanter (quanters/abs_max.py): moving-average absmax + STE
     fake-quant; the observed scale updates eagerly between steps."""
@@ -101,9 +179,11 @@ class FakeQuanterWithAbsMaxObserver(nn.Layer):
             self._absmax = cur if self._absmax is None else \
                 self.moving_rate * self._absmax + \
                 (1 - self.moving_rate) * cur
+        return fake_quant(x, jnp.float32(self.scale()), self.quant_bits)
+
+    def scale(self):
         qmax = 2 ** (self.quant_bits - 1) - 1
-        scale = max(self._absmax or 1.0, 1e-8) / qmax
-        return fake_quant(x, jnp.float32(scale), self.quant_bits)
+        return max(self._absmax or 1.0, 1e-8) / qmax
 
 
 class QuantConfig:
@@ -122,7 +202,8 @@ class QuantConfig:
         if isinstance(proto, type):
             return proto()
         return type(proto)(**{k: v for k, v in vars(proto).items()
-                              if k in ("moving_rate", "quant_bits")})
+                              if k in ("moving_rate", "quant_bits",
+                                       "channel_axis")})
 
 
 class QATLinear(nn.Layer):
@@ -133,6 +214,8 @@ class QATLinear(nn.Layer):
         self.inner = inner
         self.a_quanter = a_quanter
         self.w_quanter = w_quanter
+        if getattr(w_quanter, "channel_axis", 0) is None:
+            w_quanter.channel_axis = 1  # Linear weight [in, out]: out dim
 
     def forward(self, x):
         if self.a_quanter is not None:
@@ -146,15 +229,46 @@ class QATLinear(nn.Layer):
         return out
 
 
+class QATConv2D(nn.Layer):
+    """Training-time quantized Conv2D (reference nn/quant QuantedConv2D
+    training form): fake-quant input activation + weight, then the
+    exact conv the wrapped layer would run."""
+
+    def __init__(self, inner, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.a_quanter = a_quanter
+        self.w_quanter = w_quanter
+        if getattr(w_quanter, "channel_axis", 0) is None:
+            w_quanter.channel_axis = 0  # conv weight [out, in, kh, kw]
+
+    def forward(self, x):
+        from paddle_tpu.ops import nn_ops
+
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.inner.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(w)
+        c = self.inner
+        return nn_ops.conv2d(x, w, c.bias, c._stride, c._padding,
+                             c._dilation, c._groups, c._data_format)
+
+
 class QuantedLinear(nn.Layer):
-    """Inference-time converted Linear: int8 weight + scale, dequant at
-    the matmul edge."""
+    """Inference-time converted Linear: per-channel int8 weight + scale
+    (registered as buffers, so the converted model jit.saves with its
+    quantized state), dequant at the matmul edge."""
 
     def __init__(self, linear, act_scale=None):
         super().__init__()
-        self.qweight, self.wscale = quantize_absmax(linear.weight, axis=1)
+        qw, ws = quantize_absmax(linear.weight, axis=1)
+        self.register_buffer("qweight", Tensor._wrap(qw))
+        self.register_buffer("wscale",
+                             Tensor._wrap(jnp.asarray(ws, jnp.float32)))
         self.bias = linear.bias
-        self.act_scale = act_scale
+        self.act_scale = None if act_scale is None else float(
+            np.max(np.asarray(act_scale)))
         self.weight_shape = list(linear.weight.shape)
 
     def forward(self, x):
@@ -167,11 +281,47 @@ class QuantedLinear(nn.Layer):
             def aq(a):
                 return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
             x = apply("quant_act", aq, x)
-        w = dequantize(self.qweight, self.wscale)
+        w = dequantize(self.qweight._array, self.wscale._array)
         out = x.matmul(Tensor._wrap(w))
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+class QuantedConv2D(nn.Layer):
+    """Inference-time converted Conv2D: per-output-channel int8 weight +
+    scales as buffers, dequant at the conv edge (reference
+    nn/quant/quantized_conv.py analog)."""
+
+    def __init__(self, conv, act_scale=None):
+        super().__init__()
+        qw, ws = quantize_absmax(conv.weight, axis=0)
+        self.register_buffer("qweight", Tensor._wrap(qw))
+        self.register_buffer("wscale",
+                             Tensor._wrap(jnp.asarray(ws, jnp.float32)))
+        self.bias = conv.bias
+        self.act_scale = None if act_scale is None else float(
+            np.max(np.asarray(act_scale)))
+        self._stride = conv._stride
+        self._padding = conv._padding
+        self._dilation = conv._dilation
+        self._groups = conv._groups
+        self._data_format = conv._data_format
+
+    def forward(self, x):
+        from paddle_tpu.ops import nn_ops
+
+        if self.act_scale is not None:
+            qmax = 127
+            s = self.act_scale
+
+            def aq(a):
+                return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
+            x = apply("quant_act", aq, x)
+        w = dequantize(self.qweight._array, self.wscale._array)
+        return nn_ops.conv2d(x, Tensor._wrap(w), self.bias, self._stride,
+                             self._padding, self._dilation, self._groups,
+                             self._data_format)
 
 
 def _replace_layers(model, predicate, factory):
@@ -184,16 +334,36 @@ def _replace_layers(model, predicate, factory):
 
 
 class QAT:
-    """qat.py:QAT — wrap quantizable layers with fake-quanters."""
+    """qat.py:QAT — wrap quantizable layers (Linear + Conv2D) with
+    fake-quanters; convert() swaps the trained wrappers for int8
+    inference layers (reference QAT.convert)."""
 
     def __init__(self, config: QuantConfig):
         self.config = config
 
     def quantize(self, model, inplace=True):
         cfg = self.config
+
+        def factory(l):
+            if isinstance(l, nn.Conv2D):
+                return QATConv2D(l, cfg._make("a"), cfg._make("w"))
+            return QATLinear(l, cfg._make("a"), cfg._make("w"))
+
         return _replace_layers(
-            model, lambda l: isinstance(l, nn.Linear),
-            lambda l: QATLinear(l, cfg._make("a"), cfg._make("w")))
+            model, lambda l: isinstance(l, (nn.Linear, nn.Conv2D)),
+            factory)
+
+    def convert(self, model, inplace=True):
+        def factory(l):
+            act = l.a_quanter.scale() if l.a_quanter is not None and \
+                getattr(l.a_quanter, "_absmax", None) is not None else None
+            if isinstance(l, QATConv2D):
+                return QuantedConv2D(l.inner, act_scale=act)
+            return QuantedLinear(l.inner, act_scale=act)
+
+        return _replace_layers(
+            model, lambda l: isinstance(l, (QATLinear, QATConv2D)),
+            factory)
 
 
 class PTQ:
@@ -218,12 +388,25 @@ class PTQ:
     def quantize(self, model, inplace=True):
         cfg = self.config
         return _replace_layers(
-            model, lambda l: isinstance(l, nn.Linear),
+            model, lambda l: isinstance(l, (nn.Linear, nn.Conv2D)),
             lambda l: PTQ._Observed(l, cfg._make("a")))
 
     def convert(self, model, inplace=True):
+        def factory(l):
+            act = l.observer.scale() if l.observer else None
+            if isinstance(l.inner, nn.Conv2D):
+                return QuantedConv2D(l.inner, act_scale=act)
+            return QuantedLinear(l.inner, act_scale=act)
+
         return _replace_layers(
-            model, lambda l: isinstance(l, PTQ._Observed),
-            lambda l: QuantedLinear(
-                l.inner,
-                act_scale=l.observer.scale() if l.observer else None))
+            model, lambda l: isinstance(l, PTQ._Observed), factory)
+
+
+def convert(model, inplace=True):
+    """Module-level convert (reference quantization.convert): swap any
+    trained QAT wrappers AND any PTQ-observed layers in `model` for
+    int8 inference layers. The result jit.saves — quantized weights and
+    scales live in buffers, so the artifact carries the int8 state."""
+    QAT(QuantConfig()).convert(model, inplace=inplace)
+    PTQ().convert(model, inplace=inplace)
+    return model

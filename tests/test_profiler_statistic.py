@@ -132,3 +132,21 @@ def test_on_trace_ready_fires_once_per_cycle(tmp_path):
         prof.step()
     prof.stop()  # handler already ran when the cycle closed
     assert len(fired) == 1
+
+
+def test_traced_ops_not_attributed_to_device():
+    """block_until_ready is a no-op on tracers — trace-time dispatches
+    must land in the host column, not pollute device attribution."""
+    import paddle_tpu.jit as jit
+
+    prof = profiler.Profiler(targets=[profiler.ProfilerTarget.TPU])
+    prof.start()
+    fn = jit.to_static(lambda a: paddle.tanh(a) * 2)
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    fn(x)  # first call traces: ops dispatch on Tracer arrays
+    prof.stop()
+    data = prof.summary()
+    tanh = data.op_items.get("tanh")
+    assert tanh is not None and tanh.call >= 1
+    assert tanh.device_time == 0, "trace-time span tagged as device"
+    assert tanh.cpu_time > 0  # recorded, as a host span

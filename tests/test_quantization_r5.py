@@ -1,0 +1,154 @@
+"""Quantization depth (VERDICT r4 next #7): QATConv2D, per-channel
+observers/quanters, and quantization.convert producing a jit.save-able
+int8-simulated model. Reference: python/paddle/nn/quant/,
+static/quantization pipeline.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import quantization as Q
+
+
+def _res_block():
+    """A ResNet basic-block shape: conv-bn-relu-conv-bn + skip."""
+    paddle.seed(0)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2D(4, 4, 3, padding=1)
+            self.bn1 = nn.BatchNorm2D(4)
+            self.conv2 = nn.Conv2D(4, 4, 3, padding=1)
+            self.bn2 = nn.BatchNorm2D(4)
+            self.head = nn.Linear(4, 3)
+
+        def forward(self, x):
+            h = F.relu(self.bn1(self.conv1(x)))
+            h = self.bn2(self.conv2(h)) + x
+            return self.head(F.relu(h).mean(axis=[2, 3]))
+
+    return Block()
+
+
+def _x(seed=0, n=4):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(n, 4, 8, 8).astype(np.float32))
+
+
+def test_per_channel_quantize_absmax():
+    w = np.random.RandomState(1).randn(6, 3, 3, 3).astype(np.float32)
+    q, s = Q.quantize_absmax(w, axis=0)
+    assert q.dtype == np.int8 and s.shape == (6, 1, 1, 1)
+    # each output channel uses ITS absmax
+    for c in range(6):
+        expect = np.abs(w[c]).max() / 127
+        np.testing.assert_allclose(float(s[c, 0, 0, 0]), expect,
+                                   rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(q, np.float32) * np.asarray(s),
+                               w, atol=np.abs(w).max() / 127 + 1e-6)
+
+
+def test_per_channel_observer_and_quanter():
+    obs = Q.PerChannelAbsmaxObserver(channel_axis=0)
+    w1 = paddle.to_tensor(np.array([[1.0, -2.0], [3.0, 0.5]], np.float32))
+    w2 = paddle.to_tensor(np.array([[4.0, 0.1], [0.2, 0.3]], np.float32))
+    obs(w1)
+    obs(w2)
+    np.testing.assert_allclose(obs.scale(), np.array([4.0, 3.0]) / 127,
+                               rtol=1e-6)
+
+    quanter = Q.FakeQuanterChannelWiseAbsMax(channel_axis=0)
+    out = quanter(w1)
+    # fake-quant keeps shape; values snap to the per-channel grid
+    assert out.shape == [2, 2]
+    s = quanter.scale()
+    assert s.shape == (2,)
+    grid = np.round(np.asarray(w1) / s[:, None]) * s[:, None]
+    np.testing.assert_allclose(np.asarray(out), grid, rtol=1e-5)
+
+
+def test_qat_resnet_block_accuracy_parity_and_training():
+    block = _res_block()
+    x = _x()
+    ref = block(x).numpy()
+
+    q = Q.QAT(Q.QuantConfig(
+        activation=Q.FakeQuanterWithAbsMaxObserver(moving_rate=0.9),
+        weight=Q.FakeQuanterChannelWiseAbsMax()))
+    qblock = q.quantize(block)
+    # conv AND linear layers got wrapped
+    kinds = [type(l).__name__ for l in qblock.sublayers()]
+    assert "QATConv2D" in kinds and "QATLinear" in kinds
+
+    out = qblock(x).numpy()
+    # int8 simulation error stays small (accuracy parity tolerance)
+    assert np.abs(out - ref).max() < 0.12 * np.abs(ref).max() + 0.05
+
+    # STE: training through the fake-quant graph moves the loss
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=qblock.parameters())
+    losses = []
+    for _ in range(5):
+        loss = (qblock(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_qat_convert_to_int8_and_save(tmp_path):
+    import paddle_tpu.inference as infer
+    import paddle_tpu.jit as jit
+    from paddle_tpu.jit.api import InputSpec
+
+    block = _res_block()
+    block.eval()
+    x = _x(seed=3)
+
+    q = Q.QAT(Q.QuantConfig(
+        activation=Q.FakeQuanterWithAbsMaxObserver(),
+        weight=Q.FakeQuanterChannelWiseAbsMax()))
+    qblock = q.quantize(block)
+    for _ in range(3):  # calibrate the moving-average scales
+        qblock(_x(seed=7))
+    qat_out = qblock(x).numpy()
+
+    converted = Q.convert(qblock)
+    kinds = [type(l).__name__ for l in converted.sublayers()]
+    assert "QuantedConv2D" in kinds and "QuantedLinear" in kinds
+    for l in converted.sublayers():
+        if isinstance(l, (Q.QuantedConv2D, Q.QuantedLinear)):
+            assert str(l.qweight._array.dtype) == "int8"
+    conv_out = converted(x).numpy()
+    # converted int8 model tracks the QAT-simulated model
+    assert np.abs(conv_out - qat_out).max() < \
+        0.1 * np.abs(qat_out).max() + 0.05
+
+    # the converted model jit.saves (int8 weights + scales as buffers)
+    # and the loaded artifact reproduces it exactly
+    path = str(tmp_path / "int8_block")
+    jit.save(converted, path,
+             input_spec=[InputSpec([4, 4, 8, 8], "float32")])
+    pred = infer.create_predictor(infer.Config(path))
+    (loaded_out,) = pred.run([np.asarray(x)])
+    np.testing.assert_allclose(loaded_out, conv_out, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_ptq_conv_pipeline():
+    block = _res_block()
+    block.eval()
+    ptq = Q.PTQ(Q.QuantConfig(activation=Q.AbsmaxObserver, weight=None))
+    observed = ptq.quantize(block)
+    for s in range(3):
+        observed(_x(seed=s))
+    ref = observed(_x(seed=9)).numpy()
+    converted = ptq.convert(observed)
+    kinds = [type(l).__name__ for l in converted.sublayers()]
+    assert "QuantedConv2D" in kinds and "QuantedLinear" in kinds
+    out = converted(_x(seed=9)).numpy()
+    assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.08

@@ -167,16 +167,56 @@ def suite():
     big = _rand((64, 1 << 20))
     cases["reduce_sum_64M"] = (
         jax.jit(lambda a: a.astype(jnp.float32).sum()), (big,), None)
+
+    cases["gpt_decode_kv_32tok"] = _decode_case()
     return cases
+
+
+def _decode_case():
+    """KV-cache greedy-decode throughput (VERDICT r4 next #8): a small
+    GPT config (~21M params — the 1.3B cached-decode program takes
+    >10 min through the remote compiler, so the tracked number lives
+    here) decoding 32 new tokens per call through the SAME compiled
+    fixed-buffer lax.while_loop path the big model uses
+    (models/gpt.py _generate_cached). The fn takes a FLOAT fuzz input
+    (so _timeit's per-iteration salting varies the prompt — int inputs
+    aren't salted and XLA would hoist a constant decode out of the
+    timing loop) and returns the tokens as float (so they land in the
+    scalarized carry). rec extra: tokens per call for tokens/s."""
+    import paddle_tpu  # noqa: F401  (registers ops)
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    B, S0, L, vocab = 4, 16, 48, 4096
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=512, num_layers=6,
+                    num_heads=8, max_seq_len=L)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    new_tokens = L - S0
+
+    def decode(fuzz):
+        ids = (jnp.abs(fuzz).astype(jnp.int32) % vocab)
+        toks = model.generate(Tensor._wrap(ids), max_length=L,
+                              use_cache=True)
+        return toks._array.astype(jnp.float32)
+
+    fuzz = jnp.abs(_rand((B, S0), jnp.float32, seed=11)) * 997.0
+    flops = 2 * n_params * B * new_tokens  # matmul-dominated decode
+    return (decode, (fuzz,), flops, {"tokens": B * new_tokens})
 
 
 def run():
     results = {}
-    for name, (fn, args, flops) in suite().items():
+    for name, case in suite().items():
+        fn, args, flops = case[:3]
+        extra = case[3] if len(case) > 3 else {}
         ms = _timeit(fn, *args)
         rec = {"op": name, "ms": round(ms, 4)}
         if flops:
             rec["tflops"] = round(flops / (ms / 1e3) / 1e12, 2)
+        if extra.get("tokens"):
+            rec["tokens_per_s"] = round(extra["tokens"] / (ms / 1e3))
         results[name] = rec
         print(json.dumps(rec), flush=True)
     return results

@@ -14,12 +14,14 @@ TPU-native design keeps the table/accessor/pull/push taxonomy
 """
 from . import service
 from .embedding import DistributedEmbedding
+from .graph_table import GraphShard, GraphTable
 from .service import (Communicator, TableClient, init_ps_rpc, is_server,
                       is_worker, run_server, stop_servers)
 from .table import (MemorySparseTable, SparseAdagradRule, SparseSGDRule,
                     SSDSparseTable)
 
-__all__ = ["MemorySparseTable", "SSDSparseTable", "SparseAdagradRule",
+__all__ = ["GraphTable", "GraphShard",
+           "MemorySparseTable", "SSDSparseTable", "SparseAdagradRule",
            "SparseSGDRule",
            "DistributedEmbedding", "service", "TableClient",
            "Communicator", "init_ps_rpc", "is_server", "is_worker",

@@ -1,0 +1,153 @@
+"""Host-side graph table — minimal analog of the reference's
+GraphTable/GraphShard tier
+(paddle/fluid/distributed/ps/table/common_graph_table.h:501 GraphTable,
+:54 GraphShard; 854 LoC of brpc-served C++): adjacency + node features
+sharded by id hash, with the sampling primitives GNN trainers pull
+through the PS (random_sample_neighbors, random_sample_nodes,
+pull_graph_list, get/set_node_feat).
+
+Design matches the rest of PS-lite (table.py): shards are plain
+host-RAM dicts keyed `id % nshards`, a trainer-side facade fans
+requests out per shard, and everything returns padded numpy so the
+device side can consume fixed shapes. Weighted neighbor sampling uses
+cumulative-sum inverse transform per node — the reference's
+WeightedSampler tree serves the same distribution.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GraphShard", "GraphTable"]
+
+
+class GraphShard:
+    """One shard's adjacency + features (common_graph_table.h:54)."""
+
+    def __init__(self):
+        self.neighbors: dict = {}   # id -> (ids np.int64[k], w np.f32[k])
+        self.feats: dict = {}       # id -> {name: np.ndarray}
+
+    def add_node(self, nid):
+        self.neighbors.setdefault(int(nid),
+                                  (np.empty(0, np.int64),
+                                   np.empty(0, np.float32)))
+
+    def add_edges(self, src, dsts, weights):
+        ids0, w0 = self.neighbors.get(
+            int(src), (np.empty(0, np.int64), np.empty(0, np.float32)))
+        self.neighbors[int(src)] = (
+            np.concatenate([ids0, np.asarray(dsts, np.int64)]),
+            np.concatenate([w0, np.asarray(weights, np.float32)]))
+
+
+class GraphTable:
+    """Sharded graph store + sampling facade (common_graph_table.h:501).
+
+    ids are uint64-ish python ints; `nshards` mirrors the PS server
+    count (shard = id % nshards, the same partition rule as
+    MemorySparseTable). All sampling takes an explicit seed so
+    distributed runs stay reproducible.
+    """
+
+    def __init__(self, nshards: int = 1):
+        self.nshards = int(nshards)
+        self.shards = [GraphShard() for _ in range(self.nshards)]
+
+    def _shard(self, nid) -> GraphShard:
+        return self.shards[int(nid) % self.nshards]
+
+    # -- construction (add_graph_node / build_graph analogs) ------------
+    def add_graph_node(self, ids):
+        for nid in np.asarray(ids, np.int64).ravel():
+            self._shard(nid).add_node(nid)
+
+    def add_edges(self, src_ids, dst_ids, weights=None):
+        src = np.asarray(src_ids, np.int64).ravel()
+        dst = np.asarray(dst_ids, np.int64).ravel()
+        if len(src) != len(dst):
+            raise ValueError(f"src/dst length mismatch: "
+                             f"{len(src)} vs {len(dst)}")
+        w = (np.ones(len(src), np.float32) if weights is None
+             else np.asarray(weights, np.float32).ravel())
+        order = np.argsort(src, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
+        uniq = np.unique(src)
+        bounds = np.searchsorted(src, uniq)
+        for i, s in enumerate(uniq):
+            hi = bounds[i + 1] if i + 1 < len(bounds) else len(src)
+            self._shard(s).add_edges(s, dst[bounds[i]:hi],
+                                     w[bounds[i]:hi])
+            self.add_graph_node([s])
+        self.add_graph_node(dst)
+
+    def set_node_feat(self, ids, name, values):
+        vals = np.asarray(values)
+        for nid, v in zip(np.asarray(ids, np.int64).ravel(), vals):
+            self._shard(nid).add_node(nid)
+            self._shard(nid).feats.setdefault(int(nid), {})[name] = \
+                np.asarray(v)
+
+    # -- queries ---------------------------------------------------------
+    def get_node_feat(self, ids, name, default=0.0):
+        """[len(ids), feat_dim] array; missing nodes/features filled
+        with `default` (the reference returns empty strings there)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        rows = []
+        width = None
+        for nid in ids:
+            f = self._shard(nid).feats.get(int(nid), {}).get(name)
+            rows.append(f)
+            if f is not None and width is None:
+                width = np.asarray(f).shape
+        width = width or (1,)
+        out = np.full((len(ids),) + tuple(width), default, np.float32)
+        for i, f in enumerate(rows):
+            if f is not None:
+                out[i] = f
+        return out
+
+    def random_sample_neighbors(self, ids, sample_size, seed=0,
+                                need_weight=False):
+        """Per-id weighted sample WITH replacement ->
+        neighbors [len(ids), sample_size] int64 (-1 pads isolated
+        nodes) and optionally their weights
+        (common_graph_table.h:540 random_sample_neighbors)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        rng = np.random.RandomState(seed)
+        out = np.full((len(ids), sample_size), -1, np.int64)
+        wout = np.zeros((len(ids), sample_size), np.float32)
+        for i, nid in enumerate(ids):
+            nbrs, w = self._shard(nid).neighbors.get(
+                int(nid), (np.empty(0, np.int64), None))
+            if len(nbrs) == 0:
+                continue
+            p = w / w.sum() if w.sum() > 0 else None
+            pick = rng.choice(len(nbrs), size=sample_size, p=p)
+            out[i] = nbrs[pick]
+            wout[i] = w[pick]
+        return (out, wout) if need_weight else out
+
+    def random_sample_nodes(self, n, seed=0):
+        """n node ids drawn uniformly from the whole graph
+        (random_sample_nodes analog)."""
+        all_ids = self.node_ids()
+        if len(all_ids) == 0:
+            return np.empty(0, np.int64)
+        rng = np.random.RandomState(seed)
+        return all_ids[rng.randint(0, len(all_ids), size=n)]
+
+    def pull_graph_list(self, start, size):
+        """Deterministic node-id window [start, start+size) over the
+        sorted global id list (batch iteration for GNN epochs —
+        pull_graph_list analog)."""
+        return self.node_ids()[start:start + size]
+
+    def node_ids(self):
+        ids = [i for sh in self.shards for i in sh.neighbors]
+        return np.sort(np.asarray(ids, np.int64))
+
+    def stats(self):
+        return {"nodes": sum(len(s.neighbors) for s in self.shards),
+                "edges": sum(len(v[0]) for s in self.shards
+                             for v in s.neighbors.values()),
+                "nshards": self.nshards}

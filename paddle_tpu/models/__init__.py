@@ -1,8 +1,9 @@
 from .bert import (BertConfig, BertForPretraining,
                    BertForSequenceClassification, BertModel, ErnieModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+from .deep_fm import DeepFM
 from .wide_deep import WideDeep
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "BertConfig",
            "BertModel", "ErnieModel", "BertForSequenceClassification",
-           "BertForPretraining", "WideDeep"]
+           "BertForPretraining", "WideDeep", "DeepFM"]

@@ -1,0 +1,60 @@
+"""DeepFM CTR model over PS-lite sparse tables (BASELINE row 5's
+"wide&deep/DeepFM" wording — the reference ships both through PaddleRec
+on the parameter server, the_one_ps.py runtime).
+
+Same DistributedEmbedding host-RAM tables as WideDeep
+(models/wide_deep.py); the difference is the FM second-order term
+computed from the SAME shared embeddings the deep MLP consumes:
+0.5 * ((sum_f v_f)^2 - sum_f v_f^2) summed over the embedding dim —
+the O(B*nf*D) identity for pairwise interactions.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.ps import DistributedEmbedding, SparseAdagradRule
+
+__all__ = ["DeepFM"]
+
+
+class DeepFM(nn.Layer):
+    """ids [B, num_fields] int64 -> click probability [B, 1].
+
+    first-order: dim-1 table (like WideDeep's wide part);
+    second-order: FM pairwise interactions over the shared embeddings;
+    deep: MLP over the concatenated embeddings. Dense params train on
+    device; sparse rows via the tables' accessor rules (push_sparse).
+    """
+
+    def __init__(self, num_fields, embedding_dim=8, hidden=(64, 32),
+                 sparse_lr=0.05, nshards=None, deep_table=None,
+                 wide_table=None):
+        super().__init__()
+        self.embedding = DistributedEmbedding(
+            0, embedding_dim, table=deep_table,
+            rule=SparseAdagradRule(sparse_lr),
+            nshards=nshards, name="fm_embedding")
+        self.first_order = DistributedEmbedding(
+            0, 1, table=wide_table, rule=SparseAdagradRule(sparse_lr),
+            nshards=nshards, name="fm_first_order")
+        layers, d = [], num_fields * embedding_dim
+        for h in hidden:
+            layers += [nn.Linear(d, h), nn.ReLU()]
+            d = h
+        layers.append(nn.Linear(d, 1))
+        self.deep = nn.Sequential(*layers)
+
+    def forward(self, ids):
+        B, nf = ids.shape
+        emb = self.embedding(ids)                       # [B, nf, D]
+        first = self.first_order(ids).sum(axis=1)       # [B, 1]
+        sum_sq = emb.sum(axis=1) ** 2                   # [B, D]
+        sq_sum = (emb ** 2).sum(axis=1)                 # [B, D]
+        fm = (0.5 * (sum_sq - sq_sum)).sum(axis=1, keepdim=True)
+        deep = self.deep(emb.reshape([B, -1]))          # [B, 1]
+        return F.sigmoid(first + fm + deep)
+
+    def push_sparse(self):
+        """After loss.backward(): apply sparse-row updates."""
+        self.embedding.push_gradients()
+        self.first_order.push_gradients()

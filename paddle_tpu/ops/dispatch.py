@@ -72,9 +72,7 @@ def _timed_dispatch(name, run):
                     # early; a 1-element readback forces completion
                     # (this is what makes sync profiling cost a tunnel
                     # round-trip per op — documented trade-off)
-                    import numpy as _np
-
-                    _np.asarray(arrs[0].ravel()[:1])
+                    np.asarray(arrs[0].ravel()[:1])
                 synced = True
             except Exception:
                 pass  # non-array outputs: host span only

@@ -86,9 +86,13 @@ class AbsmaxObserver(nn.Layer):
 
 class PerChannelAbsmaxObserver(nn.Layer):
     """Per-channel PTQ observer (reference observers with quant_axis):
-    tracks max |x| per channel along `channel_axis`."""
+    tracks max |x| per channel along `channel_axis`. Defaults to axis 1
+    — the feature/channel dim of [N, C, ...] activations and [N, in]
+    linear inputs (axis 0 would be the BATCH dim: per-sample maxima
+    that break when the batch size changes); pass axis 0 explicitly
+    for OIHW weights."""
 
-    def __init__(self, quant_bits=8, channel_axis=0):
+    def __init__(self, quant_bits=8, channel_axis=1):
         super().__init__()
         self.quant_bits = quant_bits
         self.channel_axis = channel_axis
@@ -255,53 +259,60 @@ class QATConv2D(nn.Layer):
                              c._dilation, c._groups, c._data_format)
 
 
-class QuantedLinear(nn.Layer):
-    """Inference-time converted Linear: per-channel int8 weight + scale
-    (registered as buffers, so the converted model jit.saves with its
-    quantized state), dequant at the matmul edge."""
+class _QuantedBase(nn.Layer):
+    """Shared converted-layer state: per-channel int8 weight + scale
+    registered as buffers (so the converted model jit.saves with its
+    quantized state) and the PTQ-calibrated activation grid."""
 
-    def __init__(self, linear, act_scale=None):
+    def __init__(self, weight, axis, act_scale):
         super().__init__()
-        qw, ws = quantize_absmax(linear.weight, axis=1)
+        qw, ws = quantize_absmax(weight, axis=axis)
         self.register_buffer("qweight", Tensor._wrap(qw))
         self.register_buffer("wscale",
                              Tensor._wrap(jnp.asarray(ws, jnp.float32)))
-        self.bias = linear.bias
         self.act_scale = None if act_scale is None else float(
             np.max(np.asarray(act_scale)))
+
+    def _quant_act(self, x):
+        """Round x to the observed int8 activation grid (no-op without
+        a calibrated scale)."""
+        if self.act_scale is None:
+            return x
+        qmax = 127
+        s = self.act_scale
+
+        def aq(a):
+            return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
+
+        return apply("quant_act", aq, x)
+
+    def _weight(self):
+        return Tensor._wrap(
+            dequantize(self.qweight._array, self.wscale._array))
+
+
+class QuantedLinear(_QuantedBase):
+    """Inference-time converted Linear: dequant at the matmul edge."""
+
+    def __init__(self, linear, act_scale=None):
+        super().__init__(linear.weight, axis=1, act_scale=act_scale)
+        self.bias = linear.bias
         self.weight_shape = list(linear.weight.shape)
 
     def forward(self, x):
-        if self.act_scale is not None:
-            # PTQ-calibrated activation quantization (round to the
-            # observed int8 grid before the matmul)
-            qmax = 127
-            s = self.act_scale
-
-            def aq(a):
-                return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
-            x = apply("quant_act", aq, x)
-        w = dequantize(self.qweight._array, self.wscale._array)
-        out = x.matmul(Tensor._wrap(w))
+        out = self._quant_act(x).matmul(self._weight())
         if self.bias is not None:
             out = out + self.bias
         return out
 
 
-class QuantedConv2D(nn.Layer):
-    """Inference-time converted Conv2D: per-output-channel int8 weight +
-    scales as buffers, dequant at the conv edge (reference
-    nn/quant/quantized_conv.py analog)."""
+class QuantedConv2D(_QuantedBase):
+    """Inference-time converted Conv2D: per-output-channel int8 weight,
+    dequant at the conv edge (reference nn/quant/quantized_conv.py)."""
 
     def __init__(self, conv, act_scale=None):
-        super().__init__()
-        qw, ws = quantize_absmax(conv.weight, axis=0)
-        self.register_buffer("qweight", Tensor._wrap(qw))
-        self.register_buffer("wscale",
-                             Tensor._wrap(jnp.asarray(ws, jnp.float32)))
+        super().__init__(conv.weight, axis=0, act_scale=act_scale)
         self.bias = conv.bias
-        self.act_scale = None if act_scale is None else float(
-            np.max(np.asarray(act_scale)))
         self._stride = conv._stride
         self._padding = conv._padding
         self._dilation = conv._dilation
@@ -311,16 +322,9 @@ class QuantedConv2D(nn.Layer):
     def forward(self, x):
         from paddle_tpu.ops import nn_ops
 
-        if self.act_scale is not None:
-            qmax = 127
-            s = self.act_scale
-
-            def aq(a):
-                return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
-            x = apply("quant_act", aq, x)
-        w = dequantize(self.qweight._array, self.wscale._array)
-        return nn_ops.conv2d(x, Tensor._wrap(w), self.bias, self._stride,
-                             self._padding, self._dilation, self._groups,
+        return nn_ops.conv2d(self._quant_act(x), self._weight(),
+                             self.bias, self._stride, self._padding,
+                             self._dilation, self._groups,
                              self._data_format)
 
 

@@ -27,13 +27,14 @@ def main():
     import paddle_tpu.nn.functional as F
     from paddle_tpu.distributed.ps import (Communicator, SparseAdagradRule,
                                            TableClient)
-    from paddle_tpu.models import WideDeep
+    from paddle_tpu.models import DeepFM, WideDeep
 
     service.init_ps_rpc()
     tid = service.trainer_index()
 
-    # mode "ssd" = sync communicator + disk-spill tier on the servers
-    comm_mode = "sync" if mode == "ssd" else mode
+    # mode "ssd" = sync communicator + disk-spill tier on the servers;
+    # mode "deepfm" = sync communicator, DeepFM model (BASELINE row 5)
+    comm_mode = "sync" if mode in ("ssd", "deepfm") else mode
     ssd_rows = 64 if mode == "ssd" else None
     comm = Communicator(mode=comm_mode, k_steps=3)
     deep_client = TableClient("deep_table", 8,
@@ -46,8 +47,9 @@ def main():
                               communicator=wide_comm)
 
     paddle.seed(0)
-    model = WideDeep(4, embedding_dim=8, hidden=(32,),
-                     deep_table=deep_client, wide_table=wide_client)
+    model_cls = DeepFM if mode == "deepfm" else WideDeep
+    model = model_cls(4, embedding_dim=8, hidden=(32,),
+                      deep_table=deep_client, wide_table=wide_client)
     opt = paddle.optimizer.Adam(learning_rate=0.01,
                                 parameters=model.parameters())
 

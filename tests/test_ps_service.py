@@ -141,3 +141,15 @@ def test_ps_service_ssd_tier_trains_and_spills(tmp_path):
         assert r["stats"]["disk_rows"] > 0, r["stats"]
         assert r["stats"]["mem_rows"] <= 2 * 64  # 2 servers x budget
         assert r["state_rows"] == r["touched"]
+
+
+def test_ps_service_deepfm_trains(tmp_path):
+    """VERDICT r4 next #10: DeepFM through the same 2-trainer +
+    2-server launcher path as wide&deep (BASELINE row 5's
+    'wide&deep/DeepFM' wording)."""
+    results = _run_mode("deepfm", tmp_path)
+    for r in results:
+        assert r["losses"][-1] < 0.45, r["losses"][-5:]
+        assert r["losses"][-1] < r["losses"][0]
+        assert r["touched"] > 0
+        assert r["state_rows"] == r["touched"]

@@ -148,6 +148,18 @@ def bench_gpt(on_tpu):
 #   traffic as the second-largest term). 2350 img/s/chip is in line
 #   with published v5e ResNet-50 numbers; throughput, not
 #   mfu-vs-matmul-peak, is the comparable metric for the conv bench.
+# - r5 bounded fusion attempt (the one untried lever): replacing batch
+#   BN with a per-channel affine — the zero-traffic upper bound for a
+#   perfect conv+BN+ReLU fusion with epilogue stats + load-time
+#   normalize — takes a c2 bottleneck block fwd+bwd from 1.79 ms to
+#   1.14 ms at B64 (fwd-only 0.69->0.38; the gap splits evenly fwd/
+#   bwd). So full fusion could reach ~0.19-0.20 MFU, but BOTH passes
+#   need conv-kernel-resident stats/normalize: scale-shift cannot fold
+#   through ReLU into the next conv's weights, and XLA does not fuse
+#   elementwise into conv operands on TPU — realizing it means a
+#   custom Pallas conv suite (fwd+bwd), out of scope. The repo BN is
+#   already the optimal XLA formulation (single-pass f32 E[x^2]-m^2
+#   stats). The row's justification: HBM roofline, evidence above.
 
 
 def bench_bert(on_tpu):
@@ -224,7 +236,10 @@ def bench_resnet50(on_tpu):
         "resnet50_train_images_per_sec_per_chip", "images/s", imgs_s,
         3 * fwd_flops, on_tpu,
         f"batch={batch} size={size} steps={steps} compile={compile_s:.1f}s "
-        f"step={dt/steps*1000:.1f}ms loss={float(loss):.3f}")
+        f"step={dt/steps*1000:.1f}ms loss={float(loss):.3f} "
+        "| hbm-roofline row: early stages ~90% of bandwidth bound; "
+        "r5 fusion probe: perfect conv+BN fusion caps at ~0.20 MFU and "
+        "needs a custom conv suite (see header + DESIGN_DECISIONS)")
 
 
 def main():

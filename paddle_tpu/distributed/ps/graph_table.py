@@ -52,12 +52,16 @@ class GraphTable:
     def __init__(self, nshards: int = 1):
         self.nshards = int(nshards)
         self.shards = [GraphShard() for _ in range(self.nshards)]
+        self._feat_width: dict = {}   # name -> fixed feature shape
+        self._ids_cache = None        # sorted global ids (invalidated
+        #                               on any mutation)
 
     def _shard(self, nid) -> GraphShard:
         return self.shards[int(nid) % self.nshards]
 
     # -- construction (add_graph_node / build_graph analogs) ------------
     def add_graph_node(self, ids):
+        self._ids_cache = None
         for nid in np.asarray(ids, np.int64).ravel():
             self._shard(nid).add_node(nid)
 
@@ -69,6 +73,10 @@ class GraphTable:
                              f"{len(src)} vs {len(dst)}")
         w = (np.ones(len(src), np.float32) if weights is None
              else np.asarray(weights, np.float32).ravel())
+        if len(w) != len(src):
+            raise ValueError(f"weights length mismatch: "
+                             f"{len(w)} vs {len(src)} edges")
+        self._ids_cache = None
         order = np.argsort(src, kind="stable")
         src, dst, w = src[order], dst[order], w[order]
         uniq = np.unique(src)
@@ -77,31 +85,35 @@ class GraphTable:
             hi = bounds[i + 1] if i + 1 < len(bounds) else len(src)
             self._shard(s).add_edges(s, dst[bounds[i]:hi],
                                      w[bounds[i]:hi])
-            self.add_graph_node([s])
         self.add_graph_node(dst)
 
     def set_node_feat(self, ids, name, values):
+        """Set feature `name` on nodes; the FIRST set fixes the
+        feature's shape (fixed-width contract — the device side
+        consumes static shapes), later mismatches raise."""
         vals = np.asarray(values)
+        self._ids_cache = None
         for nid, v in zip(np.asarray(ids, np.int64).ravel(), vals):
+            v = np.asarray(v)
+            want = self._feat_width.setdefault(name, v.shape)
+            if v.shape != want:
+                raise ValueError(
+                    f"feature {name!r} is fixed at shape {want}; got "
+                    f"{v.shape} for node {int(nid)}")
             self._shard(nid).add_node(nid)
-            self._shard(nid).feats.setdefault(int(nid), {})[name] = \
-                np.asarray(v)
+            self._shard(nid).feats.setdefault(int(nid), {})[name] = v
 
     # -- queries ---------------------------------------------------------
     def get_node_feat(self, ids, name, default=0.0):
-        """[len(ids), feat_dim] array; missing nodes/features filled
-        with `default` (the reference returns empty strings there)."""
+        """[len(ids), *feat_shape] array — the shape registered at the
+        first set_node_feat (call-order independent); missing nodes
+        fill with `default` (the reference returns empty strings
+        there)."""
         ids = np.asarray(ids, np.int64).ravel()
-        rows = []
-        width = None
-        for nid in ids:
-            f = self._shard(nid).feats.get(int(nid), {}).get(name)
-            rows.append(f)
-            if f is not None and width is None:
-                width = np.asarray(f).shape
-        width = width or (1,)
+        width = self._feat_width.get(name, (1,))
         out = np.full((len(ids),) + tuple(width), default, np.float32)
-        for i, f in enumerate(rows):
+        for i, nid in enumerate(ids):
+            f = self._shard(nid).feats.get(int(nid), {}).get(name)
             if f is not None:
                 out[i] = f
         return out
@@ -143,8 +155,10 @@ class GraphTable:
         return self.node_ids()[start:start + size]
 
     def node_ids(self):
-        ids = [i for sh in self.shards for i in sh.neighbors]
-        return np.sort(np.asarray(ids, np.int64))
+        if self._ids_cache is None:
+            ids = [i for sh in self.shards for i in sh.neighbors]
+            self._ids_cache = np.sort(np.asarray(ids, np.int64))
+        return self._ids_cache
 
     def stats(self):
         return {"nodes": sum(len(s.neighbors) for s in self.shards),

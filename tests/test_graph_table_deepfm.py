@@ -77,3 +77,19 @@ def test_deepfm_trains_locally():
     assert losses[-1] < 0.45 < losses[0]
     acc = ((model(ids).numpy() > 0.5) == (y_np > 0.5)).mean()
     assert acc > 0.9
+
+
+def test_graph_table_feat_width_contract_and_validation():
+    g = GraphTable(nshards=2)
+    g.set_node_feat([0], "h", np.array([[1.0, 2.0]]))
+    # shape is call-order independent (fixed at first set)
+    assert g.get_node_feat([5], "h").shape == (1, 2)
+    with pytest.raises(ValueError, match="fixed at shape"):
+        g.set_node_feat([1], "h", np.array([[1.0, 2.0, 3.0]]))
+    with pytest.raises(ValueError, match="weights length"):
+        g.add_edges([0, 0], [1, 2], weights=[3.0])
+    # node_ids cache invalidates on mutation
+    g.add_edges([7], [8])
+    ids1 = g.node_ids()
+    g.add_graph_node([9])
+    assert 9 in g.node_ids() and 9 not in ids1

@@ -14,13 +14,18 @@ TPU-native design keeps the table/accessor/pull/push taxonomy
 """
 from . import service
 from .embedding import DistributedEmbedding
+from .coordinator import (ClientSelector, ClientSelectorBase,
+                          Coordinator, FLClient, FLStrategy)
 from .graph_table import GraphShard, GraphTable
+from .index_dataset import Index, TreeIndex
 from .service import (Communicator, TableClient, init_ps_rpc, is_server,
                       is_worker, run_server, stop_servers)
 from .table import (MemorySparseTable, SparseAdagradRule, SparseSGDRule,
                     SSDSparseTable)
 
-__all__ = ["GraphTable", "GraphShard",
+__all__ = ["Coordinator", "FLClient", "FLStrategy",
+           "ClientSelector", "ClientSelectorBase",
+           "GraphTable", "GraphShard", "Index", "TreeIndex",
            "MemorySparseTable", "SSDSparseTable", "SparseAdagradRule",
            "SparseSGDRule",
            "DistributedEmbedding", "service", "TableClient",

@@ -1,0 +1,249 @@
+"""FL coordinator — minimal analog of the reference's federated-
+learning PS tier (python/paddle/distributed/ps/coordinator.py:1, 378
+LoC: ClientInfoAttr / FLStrategy / ClientSelector(Base) / FLClient over
+brpc + the_one_ps protos).
+
+TPU-build shape: the coordinator is a small TCP service (same pickle
+framing as distributed/rpc.py) holding a client registry; a
+ClientSelector decides each client's per-round strategy
+(JOIN/WAIT/FINISH); JOINed clients train locally and push weighted
+state_dict updates which the coordinator folds into the global model by
+FedAvg (sample-count-weighted average — the role the reference's PS
+push/pull plays for its FL workers). Everything numpy host-side; the
+local training itself runs wherever the client runs it (TPU step, CPU
+test).
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from paddle_tpu.distributed.rpc import _recv_msg, _send_msg
+
+__all__ = ["ClientInfoAttr", "FLStrategy", "ClientSelectorBase",
+           "ClientSelector", "Coordinator", "FLClient"]
+
+
+class ClientInfoAttr:
+    """coordinator.py:38 ClientInfoAttr parity."""
+
+    CLIENT_ID = 0
+    DEVICE_TYPE = 1
+    COMPUTE_CAPACITY = 2
+    BANDWIDTH = 3
+
+
+class FLStrategy:
+    """coordinator.py:45 FLStrategy parity."""
+
+    JOIN = 0
+    WAIT = 1
+    FINISH = 2
+
+
+class ClientSelectorBase:
+    """coordinator.py:51 ClientSelectorBase: subclass and implement
+    select(clients_info, round_idx) -> {client_id: FLStrategy.*}."""
+
+    def select(self, clients_info: dict, round_idx: int) -> dict:
+        raise NotImplementedError
+
+
+class ClientSelector(ClientSelectorBase):
+    """Default selector (coordinator.py:82 ClientSelector): every
+    registered client JOINs each round until `max_rounds`, then
+    FINISH. Subclasses can use the registered capability info (e.g.
+    drop low-BANDWIDTH clients to WAIT)."""
+
+    def __init__(self, max_rounds: int = 1):
+        self.max_rounds = int(max_rounds)
+
+    def select(self, clients_info, round_idx):
+        state = (FLStrategy.FINISH if round_idx >= self.max_rounds
+                 else FLStrategy.JOIN)
+        return {cid: state for cid in clients_info}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        try:
+            cmd, payload = _recv_msg(self.request)
+        except (ConnectionError, EOFError):
+            return
+        coord: "Coordinator" = self.server.coordinator  # type: ignore
+        try:
+            _send_msg(self.request, ("ok", coord._dispatch(cmd, payload)))
+        except Exception as e:  # surface coordinator errors clientside
+            _send_msg(self.request, ("err", e))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class Coordinator:
+    """The FL server: client registry + round loop + FedAvg fold."""
+
+    def __init__(self, initial_state: dict, selector=None,
+                 min_clients: int = 1, host="127.0.0.1", port=0):
+        self.global_state = {k: np.asarray(v, np.float32)
+                             for k, v in initial_state.items()}
+        self.selector = selector or ClientSelector()
+        # cohort gate: until min_clients have registered, every pull
+        # returns WAIT — otherwise a fast first client completes early
+        # rounds solo and FedAvg silently averages a subset
+        self.min_clients = int(min_clients)
+        self.clients_info: dict = {}
+        self.round_idx = 0
+        self._round_updates: dict = {}
+        self._round_done = threading.Condition()
+        self._lock = threading.Lock()
+        self._srv = _Server((host, port), _Handler)
+        self._srv.coordinator = self  # type: ignore
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def endpoint(self):
+        ip, port = self._srv.server_address[:2]
+        return f"{ip}:{port}"
+
+    # -- protocol ----------------------------------------------------------
+    def _dispatch(self, cmd, payload):
+        if cmd == "register":
+            cid, info = payload
+            with self._lock:
+                self.clients_info[cid] = info
+            return True
+        if cmd == "pull":
+            cid = payload
+            with self._lock:
+                return (self._strategy_of(cid), self.round_idx,
+                        dict(self.global_state))
+        if cmd == "round":
+            # lightweight poll: strategy + round index WITHOUT the
+            # global state (WAIT/advance polling must not ship weights)
+            cid = payload
+            with self._lock:
+                return (self._strategy_of(cid), self.round_idx)
+        if cmd == "push":
+            cid, round_idx, state, n_samples = payload
+            self._fold(cid, round_idx, state, n_samples)
+            return True
+        raise ValueError(f"unknown FL command {cmd!r}")
+
+    def _strategy_of(self, cid):
+        """Per-client strategy under the lock; WAIT while the cohort is
+        still assembling (min_clients gate)."""
+        if len(self.clients_info) < self.min_clients:
+            return FLStrategy.WAIT
+        return self.selector.select(
+            self.clients_info, self.round_idx).get(cid, FLStrategy.WAIT)
+
+    def _fold(self, cid, round_idx, state, n_samples):
+        """Collect one client's update; when every JOINed client of the
+        round has pushed, fold the sample-weighted average into the
+        global model and advance the round (FedAvg)."""
+        with self._lock:
+            if round_idx != self.round_idx:
+                return  # stale update from a past round: dropped
+            self._round_updates[cid] = (state, float(n_samples))
+            if len(self.clients_info) < self.min_clients:
+                return  # cohort still assembling
+            joined = [c for c, s in self.selector.select(
+                self.clients_info, self.round_idx).items()
+                if s == FLStrategy.JOIN]
+            if set(self._round_updates) < set(joined):
+                return
+            total = sum(n for _, n in self._round_updates.values())
+            new = {}
+            for k in self.global_state:
+                new[k] = sum(
+                    np.asarray(st[k], np.float32) * (n / total)
+                    for st, n in self._round_updates.values())
+            self.global_state = new
+            self._round_updates = {}
+            self.round_idx += 1
+        with self._round_done:
+            self._round_done.notify_all()
+
+    def wait_rounds(self, n, timeout=120):
+        """Block until `n` FedAvg rounds completed."""
+        with self._round_done:
+            self._round_done.wait_for(lambda: self.round_idx >= n,
+                                      timeout=timeout)
+        return self.round_idx
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class _CoordClient:
+    def __init__(self, endpoint):
+        ip, port = endpoint.rsplit(":", 1)
+        self._addr = (ip, int(port))
+
+    def call(self, cmd, payload):
+        with socket.create_connection(self._addr, timeout=60) as s:
+            _send_msg(s, (cmd, payload))
+            status, out = _recv_msg(s)
+        if status == "err":
+            raise out
+        return out
+
+
+class FLClient:
+    """coordinator.py:105 FLClientBase analog: register capability
+    info, then run the pull-strategy / local-train / push-update loop.
+
+        client = FLClient(endpoint, client_id=0,
+                          info={ClientInfoAttr.DEVICE_TYPE: "tpu"})
+        client.run(train_fn)   # train_fn(global_state) ->
+                               #   (new_state, n_samples)
+    """
+
+    def __init__(self, endpoint, client_id, info=None):
+        self._rpc = _CoordClient(endpoint)
+        self.client_id = client_id
+        self.info = info or {}
+        self._rpc.call("register", (client_id, self.info))
+
+    def pull(self):
+        """-> (FLStrategy.*, round_idx, global_state)."""
+        return self._rpc.call("pull", self.client_id)
+
+    def poll_round(self):
+        """-> (FLStrategy.*, round_idx) — no weights shipped."""
+        return self._rpc.call("round", self.client_id)
+
+    def push(self, round_idx, state, n_samples):
+        self._rpc.call("push",
+                       (self.client_id, round_idx, state, n_samples))
+
+    def run(self, train_fn, poll_interval=0.05):
+        """The reference FL worker loop: JOIN -> local train + push;
+        WAIT -> poll; FINISH -> return rounds participated."""
+        import time
+
+        rounds = 0
+        while True:
+            strategy, round_idx = self.poll_round()
+            if strategy == FLStrategy.FINISH:
+                return rounds
+            if strategy == FLStrategy.WAIT:
+                time.sleep(poll_interval)
+                continue
+            _, round_idx, global_state = self.pull()
+            new_state, n = train_fn(global_state)
+            self.push(round_idx, new_state, n)
+            rounds += 1
+            # wait for the round to advance before pulling again so a
+            # fast client doesn't re-train the same round (lightweight
+            # poll: the weights are only fetched when JOINing)
+            while self.poll_round()[1] == round_idx:
+                time.sleep(poll_interval)

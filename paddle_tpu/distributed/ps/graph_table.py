@@ -158,6 +158,9 @@ class GraphTable:
         if self._ids_cache is None:
             ids = [i for sh in self.shards for i in sh.neighbors]
             self._ids_cache = np.sort(np.asarray(ids, np.int64))
+            # callers get the cache by reference; read-only so caller
+            # mutation can't corrupt it (views inherit the flag)
+            self._ids_cache.setflags(write=False)
         return self._ids_cache
 
     def stats(self):

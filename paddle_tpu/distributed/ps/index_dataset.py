@@ -1,0 +1,239 @@
+"""Tree index for recall models — minimal analog of the reference's
+index_dataset tier (paddle/fluid/distributed/index_dataset/
+index_wrapper.h TreeIndex + index_sampler.h LayerWiseSampler;
+python surface python/paddle/distributed/fleet/dataset/
+index_dataset.py:24 TreeIndex).
+
+The reference stores a TDM-style complete k-ary tree of items in a
+protobuf KV file and serves code/ancestor lookups + layerwise negative
+sampling to trainers. Here the tree is a host-side numpy structure with
+the same code arithmetic (root code 0; children of c are
+c*branch+1 .. c*branch+branch) and the same API surface; persistence is
+a pickle (save/load) instead of the proto KV store.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = ["Index", "TreeIndex"]
+
+
+class Index:
+    """index_dataset.py:20 base."""
+
+    def __init__(self, name):
+        self._name = name
+
+
+class TreeIndex(Index):
+    """Complete `branch`-ary tree over items; leaves sit at the deepest
+    level, left-aligned. Items keep their uint64 ids; internal nodes
+    get synthetic ids above max(item id)."""
+
+    def __init__(self, name, path=None):
+        super().__init__(name)
+        self._sampler = None
+        if path is not None:
+            with open(path, "rb") as f:
+                d = pickle.load(f)
+            (self._branch, self._height, self._codes, self._ids,
+             self._is_leaf, self._prob) = d
+            self._build_lookups()
+
+    def _build_lookups(self):
+        """O(total_nodes) ONCE: code<->id dicts + per-level sorted code
+        arrays, so per-row sampling work is O(1)/O(log) instead of
+        full-table scans (a TDM-scale tree has millions of nodes)."""
+        self._code2id = {int(c): int(i)
+                         for c, i in zip(self._codes, self._ids)}
+        self._id2code = {int(i): int(c)
+                         for c, i in zip(self._codes, self._ids)}
+        self._level_codes = [self._layer_codes_scan(lv)
+                             for lv in range(self._height)]
+
+    @classmethod
+    def from_items(cls, name, item_ids, branch=2, probabilities=None):
+        """Build the tree from leaf item ids (TreeIndex builder
+        analog). height = levels count; leaves at level height-1."""
+        item_ids = np.asarray(item_ids, np.uint64)
+        n = len(item_ids)
+        if n == 0:
+            raise ValueError("empty item list")
+        branch = int(branch)
+        height = 1
+        while branch ** (height - 1) < n:
+            height += 1
+        if probabilities is not None and len(probabilities) != n:
+            raise ValueError(f"probabilities length mismatch: "
+                             f"{len(probabilities)} vs {n} items")
+        t = cls(name)
+        t._branch = branch
+        t._height = height
+        first_leaf = (branch ** (height - 1) - 1) // (branch - 1) \
+            if branch > 1 else height - 1
+        leaf_codes = first_leaf + np.arange(n)
+        # code -> (id, is_leaf, prob) maps, ancestors get synthetic ids
+        codes = [leaf_codes]
+        ids = [item_ids]
+        leaf = [np.ones(n, bool)]
+        prob = [np.asarray(probabilities, np.float32)
+                if probabilities is not None
+                else np.full(n, 1.0 / n, np.float32)]
+        next_id = int(item_ids.max()) + 1
+        cur_codes, cur_prob = leaf_codes, prob[0]
+        while cur_codes[0] != 0:
+            parents, inv = np.unique((cur_codes - 1) // branch,
+                                     return_inverse=True)
+            pprob = np.zeros(len(parents), np.float32)
+            np.add.at(pprob, inv, cur_prob)
+            codes.append(parents)
+            ids.append(np.arange(next_id, next_id + len(parents),
+                                 dtype=np.uint64))
+            next_id += len(parents)
+            leaf.append(np.zeros(len(parents), bool))
+            prob.append(pprob)
+            cur_codes, cur_prob = parents, pprob
+        t._codes = np.concatenate(codes)
+        t._ids = np.concatenate(ids)
+        t._is_leaf = np.concatenate(leaf)
+        t._prob = np.concatenate(prob)
+        t._build_lookups()
+        return t
+
+    def save(self, path):
+        with open(path, "wb") as f:
+            pickle.dump((self._branch, self._height, self._codes,
+                         self._ids, self._is_leaf, self._prob), f)
+
+    # -- metadata (index_dataset.py:36-48 parity) ------------------------
+    def height(self):
+        return self._height
+
+    def branch(self):
+        return self._branch
+
+    def total_node_nums(self):
+        return len(self._codes)
+
+    def emb_size(self):
+        """Embedding-table size needed for node ids (max id + 1)."""
+        return int(self._ids.max()) + 1
+
+    def get_all_leafs(self):
+        return self._ids[self._is_leaf]
+
+    # -- code arithmetic --------------------------------------------------
+    def _level_of(self, code):
+        lvl = 0
+        c = int(code)
+        while c != 0:
+            c = (c - 1) // self._branch
+            lvl += 1
+        return lvl
+
+    def _code_of_id(self, nid):
+        try:
+            return self._id2code[int(nid)]
+        except KeyError:
+            raise KeyError(f"id {nid} not in tree") from None
+
+    def get_nodes(self, codes):
+        """codes -> node ids (missing codes raise)."""
+        return np.asarray([self._code2id[int(c)] for c in codes],
+                          np.uint64)
+
+    def _layer_codes_scan(self, level):
+        if self._branch > 1:
+            lo = (self._branch ** level - 1) // (self._branch - 1)
+            hi = (self._branch ** (level + 1) - 1) // (self._branch - 1)
+        else:
+            lo, hi = level, level + 1
+        mask = (self._codes >= lo) & (self._codes < hi)
+        return np.sort(self._codes[mask])
+
+    def get_layer_codes(self, level):
+        return self._level_codes[level]
+
+    def get_travel_codes(self, nid, start_level=0):
+        """Leaf id -> [leaf code, parent, ..., level start_level]
+        (index_dataset.py:57)."""
+        c = self._code_of_id(nid)
+        out = []
+        lvl = self._level_of(c)
+        while lvl >= start_level:
+            out.append(c)
+            if c == 0:
+                break
+            c = (c - 1) // self._branch
+            lvl -= 1
+        return np.asarray(out, np.int64)
+
+    def get_ancestor_codes(self, ids, level):
+        out = []
+        for nid in ids:
+            c = self._code_of_id(nid)
+            lvl = self._level_of(c)
+            while lvl > level:
+                c = (c - 1) // self._branch
+                lvl -= 1
+            out.append(c)
+        return np.asarray(out, np.int64)
+
+    def get_children_codes(self, ancestor_code, level):
+        alvl = self._level_of(ancestor_code)
+        codes = np.asarray([int(ancestor_code)], np.int64)
+        for _ in range(level - alvl):
+            codes = (codes[:, None] * self._branch + 1 +
+                     np.arange(self._branch)).ravel()
+        present = np.isin(codes, self._codes)
+        return codes[present]
+
+    def get_pi_relation(self, ids, level):
+        """{item id: its level-`level` ancestor code}."""
+        anc = self.get_ancestor_codes(ids, level)
+        return {int(i): int(a) for i, a in zip(ids, anc)}
+
+    # -- layerwise sampling (index_sampler.h LayerWiseSampler) -----------
+    def init_layerwise_sampler(self, layer_sample_counts,
+                               start_sample_layer=1, seed=0):
+        if len(layer_sample_counts) != self._height - start_sample_layer:
+            raise ValueError(
+                f"need {self._height - start_sample_layer} layer counts "
+                f"(layers {start_sample_layer}..{self._height - 1}), "
+                f"got {len(layer_sample_counts)}")
+        self._sampler = (list(layer_sample_counts),
+                         int(start_sample_layer),
+                         np.random.RandomState(seed))
+
+    def layerwise_sample(self, user_input, index_input,
+                         with_hierarchy=False):
+        """TDM training sample expansion: for each (user features,
+        target item) pair emit, per layer, the positive ancestor
+        (label 1) plus `layer_sample_counts[l]` uniform negatives from
+        that layer (label 0). Returns (users, node_ids, labels)."""
+        if self._sampler is None:
+            raise RuntimeError("call init_layerwise_sampler first")
+        if with_hierarchy:
+            raise NotImplementedError(
+                "with_hierarchy=True (the reference's hierarchical "
+                "user-feature expansion) is not implemented — flat "
+                "expansion only")
+        counts, start, rng = self._sampler
+        users, nodes, labels = [], [], []
+        for u, item in zip(user_input, index_input):
+            for li, k in enumerate(counts):
+                level = start + li
+                layer = self.get_layer_codes(level)
+                pos = self.get_ancestor_codes([item], level)[0]
+                neg_pool = layer[layer != pos]
+                take = min(k, len(neg_pool))
+                negs = rng.choice(neg_pool, size=take, replace=False) \
+                    if take else np.empty(0, np.int64)
+                for code, lab in [(pos, 1)] + [(c, 0) for c in negs]:
+                    users.append(u)
+                    nodes.append(self.get_nodes([code])[0])
+                    labels.append(lab)
+        return (np.asarray(users), np.asarray(nodes, np.uint64),
+                np.asarray(labels, np.int64))

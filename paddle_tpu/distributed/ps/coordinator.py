@@ -154,17 +154,22 @@ class Coordinator:
             self._round_updates[cid] = (state, float(n_samples))
             if len(self.clients_info) < self.min_clients:
                 return  # cohort still assembling
-            joined = [c for c, s in self.selector.select(
+            joined = {c for c, s in self.selector.select(
                 self.clients_info, self.round_idx).items()
-                if s == FLStrategy.JOIN]
-            if set(self._round_updates) < set(joined):
+                if s == FLStrategy.JOIN}
+            # fold only when EVERY joined client pushed, and average
+            # only the joined clients' updates — a stray push from a
+            # WAITed client must neither trigger the fold early nor
+            # contaminate the round's average
+            if not joined or not joined <= set(self._round_updates):
                 return
-            total = sum(n for _, n in self._round_updates.values())
+            folded = {c: self._round_updates[c] for c in joined}
+            total = sum(n for _, n in folded.values())
             new = {}
             for k in self.global_state:
                 new[k] = sum(
                     np.asarray(st[k], np.float32) * (n / total)
-                    for st, n in self._round_updates.values())
+                    for st, n in folded.values())
             self.global_state = new
             self._round_updates = {}
             self.round_idx += 1

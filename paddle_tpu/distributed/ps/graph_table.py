@@ -17,7 +17,16 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["GraphShard", "GraphTable"]
+__all__ = ["GraphShard", "GraphTable", "uniform_sample_ids"]
+
+
+def uniform_sample_ids(all_ids, n, seed=0):
+    """n uniform draws (with replacement) from an id array — shared by
+    the local table and the rpc client."""
+    if len(all_ids) == 0:
+        return np.empty(0, np.int64)
+    rng = np.random.RandomState(seed)
+    return np.asarray(all_ids)[rng.randint(0, len(all_ids), size=n)]
 
 
 class GraphShard:
@@ -65,7 +74,12 @@ class GraphTable:
         for nid in np.asarray(ids, np.int64).ravel():
             self._shard(nid).add_node(nid)
 
-    def add_edges(self, src_ids, dst_ids, weights=None):
+    def add_edges(self, src_ids, dst_ids, weights=None,
+                  register_dst=True):
+        """register_dst=False skips dst node registration — the
+        rpc-served path routes dst nodes to THEIR owning shard
+        client-side; registering them here (the src's shard) would
+        double-count nodes across servers."""
         src = np.asarray(src_ids, np.int64).ravel()
         dst = np.asarray(dst_ids, np.int64).ravel()
         if len(src) != len(dst):
@@ -85,7 +99,8 @@ class GraphTable:
             hi = bounds[i + 1] if i + 1 < len(bounds) else len(src)
             self._shard(s).add_edges(s, dst[bounds[i]:hi],
                                      w[bounds[i]:hi])
-        self.add_graph_node(dst)
+        if register_dst:
+            self.add_graph_node(dst)
 
     def set_node_feat(self, ids, name, values):
         """Set feature `name` on nodes; the FIRST set fixes the
@@ -104,13 +119,16 @@ class GraphTable:
             self._shard(nid).feats.setdefault(int(nid), {})[name] = v
 
     # -- queries ---------------------------------------------------------
-    def get_node_feat(self, ids, name, default=0.0):
+    def get_node_feat(self, ids, name, default=0.0, width=None):
         """[len(ids), *feat_shape] array — the shape registered at the
         first set_node_feat (call-order independent); missing nodes
         fill with `default` (the reference returns empty strings
-        there)."""
+        there). `width` overrides the shape for shards that never saw
+        the feature (the rpc-served path, where the CLIENT is the
+        width authority)."""
         ids = np.asarray(ids, np.int64).ravel()
-        width = self._feat_width.get(name, (1,))
+        width = tuple(width) if width is not None \
+            else self._feat_width.get(name, (1,))
         out = np.full((len(ids),) + tuple(width), default, np.float32)
         for i, nid in enumerate(ids):
             f = self._shard(nid).feats.get(int(nid), {}).get(name)
@@ -142,11 +160,7 @@ class GraphTable:
     def random_sample_nodes(self, n, seed=0):
         """n node ids drawn uniformly from the whole graph
         (random_sample_nodes analog)."""
-        all_ids = self.node_ids()
-        if len(all_ids) == 0:
-            return np.empty(0, np.int64)
-        rng = np.random.RandomState(seed)
-        return all_ids[rng.randint(0, len(all_ids), size=n)]
+        return uniform_sample_ids(self.node_ids(), n, seed)
 
     def pull_graph_list(self, start, size):
         """Deterministic node-id window [start, start+size) over the

@@ -41,7 +41,7 @@ from .table import MemorySparseTable, SparseAdagradRule, SparseSGDRule
 __all__ = [
     "role", "is_server", "is_worker", "num_servers", "num_trainers",
     "server_index", "trainer_index", "init_ps_rpc", "run_server",
-    "stop_servers", "TableClient", "Communicator",
+    "stop_servers", "TableClient", "GraphTableClient", "Communicator",
 ]
 
 
@@ -215,6 +215,22 @@ def stop_servers():
 # trainer side
 # ---------------------------------------------------------------------------
 
+def _discover_servers():
+    """Sorted server names from the rpc world (shared by TableClient
+    and GraphTableClient)."""
+    from paddle_tpu.distributed import rpc
+
+    servers = sorted(
+        (w.name for w in rpc.get_all_worker_infos()
+         if w.name.startswith("server:")),
+        key=lambda n: int(n.split(":")[1]))
+    if not servers:
+        raise RuntimeError(
+            "no PS servers in the rpc world — launch with "
+            "--servers N and call init_ps_rpc() first")
+    return servers
+
+
 def _rule_spec(rule):
     if rule is None:
         return "adagrad", {}
@@ -239,14 +255,7 @@ class TableClient:
 
         self.name = name
         self.dim = dim
-        self._servers = sorted(
-            (w.name for w in rpc.get_all_worker_infos()
-             if w.name.startswith("server:")),
-            key=lambda n: int(n.split(":")[1]))
-        if not self._servers:
-            raise RuntimeError(
-                "no PS servers in the rpc world — launch with "
-                "--servers N and call init_ps_rpc() first")
+        self._servers = _discover_servers()
         kind, kwargs = _rule_spec(rule)
         for s in self._servers:
             rpc.rpc_sync(s, _srv_ensure_table,
@@ -461,3 +470,203 @@ class Communicator:
                 self._queue.put(None)
                 self._thread.join(timeout=10)
                 self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# graph table service (common_graph_table.h served over brpc, here the
+# same rpc world as the sparse tables — shard = id % num_servers)
+# ---------------------------------------------------------------------------
+
+_GRAPH_TABLES: dict = {}
+_GRAPH_LOCKS: dict = {}
+
+
+def _srv_graph_ensure(name):
+    from .graph_table import GraphTable
+
+    with _CREATE_LOCK:
+        if name not in _GRAPH_TABLES:
+            # each server holds ONE shard; cross-server partitioning is
+            # the client's id % num_servers routing
+            _GRAPH_TABLES[name] = GraphTable(nshards=1)
+            _GRAPH_LOCKS[name] = threading.Lock()
+    return True
+
+
+def _srv_graph_add_edges(name, src, dst, w):
+    with _GRAPH_LOCKS[name]:
+        # dst registration is the CLIENT's cross-shard routing job
+        _GRAPH_TABLES[name].add_edges(src, dst, w, register_dst=False)
+    return True
+
+
+def _srv_graph_add_nodes(name, ids):
+    with _GRAPH_LOCKS[name]:
+        _GRAPH_TABLES[name].add_graph_node(ids)
+    return True
+
+
+def _srv_graph_set_feat(name, ids, values, fname):
+    # (ids, values) ride the client's per-id scatter; fname is extra
+    with _GRAPH_LOCKS[name]:
+        _GRAPH_TABLES[name].set_node_feat(ids, fname, values)
+    return True
+
+
+def _srv_graph_get_feat(name, ids, fname, width):
+    with _GRAPH_LOCKS[name]:
+        return _GRAPH_TABLES[name].get_node_feat(ids, fname,
+                                                 width=width)
+
+
+def _srv_graph_feat_width(name, fname):
+    """This server's registered shape for feature `fname` (None if it
+    never stored it) — lets a pure-reader client learn the width."""
+    with _GRAPH_LOCKS[name]:
+        w = _GRAPH_TABLES[name]._feat_width.get(fname)
+        return None if w is None else tuple(w)
+
+
+def _srv_graph_sample_neighbors(name, ids, k, seed, need_weight):
+    with _GRAPH_LOCKS[name]:
+        return _GRAPH_TABLES[name].random_sample_neighbors(
+            ids, k, seed=seed, need_weight=need_weight)
+
+
+def _srv_graph_node_ids(name):
+    with _GRAPH_LOCKS[name]:
+        return np.asarray(_GRAPH_TABLES[name].node_ids())
+
+
+def _srv_graph_stats(name):
+    with _GRAPH_LOCKS[name]:
+        return _GRAPH_TABLES[name].stats()
+
+
+class GraphTableClient:
+    """Trainer-side handle to a graph table sharded over the server
+    processes — the GraphTable API re-exposed over rpc with
+    id % num_servers routing (the role brpc serving plays for
+    common_graph_table.h). The client is the width authority for node
+    features, so shards that never stored a feature still return
+    correctly shaped defaults."""
+
+    def __init__(self, name):
+        from paddle_tpu.distributed import rpc
+
+        self.name = name
+        self._servers = _discover_servers()
+        self._feat_width: dict = {}
+        for s in self._servers:
+            rpc.rpc_sync(s, _srv_graph_ensure, args=(name,))
+
+    def _owner(self, ids):
+        return np.asarray(ids, np.int64) % len(self._servers)
+
+    def _scatter(self, fn, ids, *per_id_cols, extra=()):
+        """Partition ids (and aligned per-id columns) by owner, rpc
+        each server once, return {server_idx: (future, mask)}."""
+        from paddle_tpu.distributed import rpc
+
+        ids = np.asarray(ids, np.int64).ravel()
+        owners = self._owner(ids)
+        futs = {}
+        for j, s in enumerate(self._servers):
+            mask = owners == j
+            if mask.any():
+                cols = tuple(np.asarray(c)[mask] for c in per_id_cols)
+                futs[j] = (rpc.rpc_async(
+                    s, fn, args=(self.name, ids[mask]) + cols + extra),
+                    mask)
+        return ids, futs
+
+    def add_graph_node(self, ids):
+        _, futs = self._scatter(_srv_graph_add_nodes, ids)
+        for f, _ in futs.values():
+            f.result()
+
+    def add_edges(self, src_ids, dst_ids, weights=None):
+        """Edges live with their SOURCE node's server (the reference's
+        partition — neighbors are sampled where src lives); dst nodes
+        register on their own servers."""
+        src = np.asarray(src_ids, np.int64).ravel()
+        dst = np.asarray(dst_ids, np.int64).ravel()
+        w = (np.ones(len(src), np.float32) if weights is None
+             else np.asarray(weights, np.float32).ravel())
+        _, futs = self._scatter(_srv_graph_add_edges, src, dst, w)
+        for f, _ in futs.values():
+            f.result()
+        self.add_graph_node(dst)
+
+    def set_node_feat(self, ids, fname, values):
+        vals = np.asarray(values)
+        want = self._feat_width.setdefault(fname, vals.shape[1:])
+        if vals.shape[1:] != want:
+            raise ValueError(f"feature {fname!r} is fixed at shape "
+                             f"{want}; got {vals.shape[1:]}")
+        _, futs = self._scatter(_srv_graph_set_feat, ids, vals,
+                                extra=(fname,))
+        # NOTE extra goes AFTER per-id cols: server signature is
+        # (name, ids, values, fname)
+        for f, _ in futs.values():
+            f.result()
+
+    def _width_of(self, fname):
+        """Feature width: locally registered, else learned from the
+        servers (a pure-reader client never called set_node_feat)."""
+        if fname not in self._feat_width:
+            from paddle_tpu.distributed import rpc
+
+            for s in self._servers:
+                w = rpc.rpc_sync(s, _srv_graph_feat_width,
+                                 args=(self.name, fname))
+                if w is not None:
+                    self._feat_width[fname] = tuple(w)
+                    break
+        return self._feat_width.get(fname, (1,))
+
+    def get_node_feat(self, ids, fname, default=0.0):
+        width = self._width_of(fname)
+        ids, futs = self._scatter(_srv_graph_get_feat, ids,
+                                  extra=(fname, width))
+        out = np.full((len(ids),) + tuple(width), default, np.float32)
+        for f, mask in futs.values():
+            out[mask] = f.result()
+        return out
+
+    def random_sample_neighbors(self, ids, sample_size, seed=0,
+                                need_weight=False):
+        ids, futs = self._scatter(
+            _srv_graph_sample_neighbors, ids,
+            extra=(sample_size, seed, need_weight))
+        out = np.full((len(ids), sample_size), -1, np.int64)
+        wout = np.zeros((len(ids), sample_size), np.float32)
+        for f, mask in futs.values():
+            r = f.result()
+            if need_weight:
+                out[mask], wout[mask] = r
+            else:
+                out[mask] = r
+        return (out, wout) if need_weight else out
+
+    def node_ids(self):
+        from paddle_tpu.distributed import rpc
+
+        parts = [rpc.rpc_sync(s, _srv_graph_node_ids, args=(self.name,))
+                 for s in self._servers]
+        return np.sort(np.concatenate(parts)) if parts else \
+            np.empty(0, np.int64)
+
+    def random_sample_nodes(self, n, seed=0):
+        from .graph_table import uniform_sample_ids
+
+        return uniform_sample_ids(self.node_ids(), n, seed)
+
+    def stats(self):
+        from paddle_tpu.distributed import rpc
+
+        per = [rpc.rpc_sync(s, _srv_graph_stats, args=(self.name,))
+               for s in self._servers]
+        return {"nodes": sum(p["nodes"] for p in per),
+                "edges": sum(p["edges"] for p in per),
+                "nshards": len(self._servers)}

@@ -12,6 +12,45 @@ import sys
 import numpy as np
 
 
+def _graph_mode(service, tid, out_file):
+    """GraphTableClient e2e: each trainer loads a disjoint slice of a
+    shared graph into the 2 servers, waits until the WHOLE graph is
+    visible, then both sample neighbors + read features written by the
+    OTHER trainer."""
+    import time
+
+    from paddle_tpu.distributed.ps.service import GraphTableClient
+
+    g = GraphTableClient("social")
+    # trainer t owns sources {10+t, 20+t}: edges to a shared hub 99
+    base = 10 + tid
+    g.add_edges([base, base, 20 + tid], [99, base + 100, 99],
+                weights=[5.0, 1.0, 1.0])
+    g.set_node_feat([base], "h", np.array([[float(tid), 1.0]]))
+    # whole graph = {10,11,20,21,99,110,111}: wait until the other
+    # trainer's slice AND its feature write landed on the servers (the
+    # node count alone races the in-flight set_node_feat rpc)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if (g.stats()["nodes"] >= 7
+                and g.get_node_feat([10 + (1 - tid)], "h")[0, 1] == 1.0):
+            break
+        time.sleep(0.1)
+    st = g.stats()
+    nbrs = g.random_sample_neighbors([10 + (1 - tid)], 64, seed=tid)
+    other_feat = g.get_node_feat([10 + (1 - tid)], "h")
+    result = {
+        "stats": st,
+        "other_neighbors": sorted(set(map(int, nbrs.ravel()))),
+        "other_feat": other_feat.tolist(),
+    }
+    if out_file:
+        with open(f"{out_file}.{tid}", "w") as f:
+            json.dump(result, f)
+    print(f"TRAINER_DONE graph nodes={st['nodes']}", flush=True)
+    service.stop_servers()
+
+
 def main():
     mode = sys.argv[1]
     out_file = sys.argv[2] if len(sys.argv) > 2 else None
@@ -31,6 +70,10 @@ def main():
 
     service.init_ps_rpc()
     tid = service.trainer_index()
+
+    if mode == "graph":
+        _graph_mode(service, tid, out_file)
+        return
 
     # mode "ssd" = sync communicator + disk-spill tier on the servers;
     # mode "deepfm" = sync communicator, DeepFM model (BASELINE row 5)

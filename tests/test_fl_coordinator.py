@@ -189,3 +189,29 @@ def test_tree_index_validation():
     with pytest.raises(NotImplementedError, match="hierarchy"):
         t.layerwise_sample(np.zeros((1, 1)), items[:1],
                            with_hierarchy=True)
+
+
+def test_waited_client_push_cannot_contaminate_round():
+    """A stray push from a WAITed client must neither trigger the fold
+    early nor enter the round's average."""
+
+    class FastOnly(ClientSelectorBase):
+        def select(self, clients_info, round_idx):
+            if round_idx >= 1:
+                return {c: FLStrategy.FINISH for c in clients_info}
+            return {c: (FLStrategy.JOIN if c == "fast"
+                        else FLStrategy.WAIT)
+                    for c in clients_info}
+
+    coord = Coordinator({"w": np.zeros(1)}, selector=FastOnly())
+    try:
+        fast = FLClient(coord.endpoint, "fast")
+        slow = FLClient(coord.endpoint, "slow")
+        slow.push(0, {"w": np.array([100.0])}, 1000)  # stray push
+        assert coord.round_idx == 0                   # no early fold
+        fast.push(0, {"w": np.array([5.0])}, 10)
+        assert coord.wait_rounds(1) == 1
+        # ONLY the joined client's update entered the average
+        np.testing.assert_allclose(coord.global_state["w"], [5.0])
+    finally:
+        coord.close()

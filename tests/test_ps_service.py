@@ -153,3 +153,18 @@ def test_ps_service_deepfm_trains(tmp_path):
         assert r["losses"][-1] < r["losses"][0]
         assert r["touched"] > 0
         assert r["state_rows"] == r["touched"]
+
+
+def test_ps_service_graph_table(tmp_path):
+    """GraphTableClient through the 2-trainer + 2-server launcher: a
+    graph built by BOTH trainers is visible to each (rpc-shard routing
+    by id % num_servers), weighted neighbor sampling and cross-trainer
+    feature reads work."""
+    results = _run_mode("graph", tmp_path)
+    for tid, r in enumerate(results):
+        assert r["stats"]["nodes"] == 7 and r["stats"]["edges"] == 6
+        assert r["stats"]["nshards"] == 2
+        # the OTHER trainer's source node links to {99, 110+(1-tid)}
+        assert set(r["other_neighbors"]) == {99, 110 + (1 - tid)}
+        # and carries the feature the other trainer wrote
+        assert r["other_feat"] == [[float(1 - tid), 1.0]]

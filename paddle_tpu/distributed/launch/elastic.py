@@ -29,6 +29,11 @@ local pod from remote hosts:
 """
 from __future__ import annotations
 
+# Wire format: newline-delimited JSON, deliberately NOT the rpc tier's
+# length-prefixed pickle framing — membership records are tiny, and a
+# human (or the `launch.elastic live` CLI) can poke the registry with
+# netcat when debugging a wedged pod; pickle would also let a rogue
+# host on the rendezvous port execute code in the launcher.
 import json
 import socket
 import socketserver

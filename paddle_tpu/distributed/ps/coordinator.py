@@ -132,6 +132,12 @@ class Coordinator:
                 return (self._strategy_of(cid), self.round_idx)
         if cmd == "push":
             cid, round_idx, state, n_samples = payload
+            if float(n_samples) <= 0:
+                # rejected at the door: a zero-weight update would make
+                # the FedAvg denominator 0 and wedge the round
+                raise ValueError(
+                    f"push from {cid!r} with n_samples={n_samples}; "
+                    "a client with no data must not JOIN the round")
             self._fold(cid, round_idx, state, n_samples)
             return True
         raise ValueError(f"unknown FL command {cmd!r}")

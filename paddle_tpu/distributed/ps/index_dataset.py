@@ -62,6 +62,11 @@ class TreeIndex(Index):
         if n == 0:
             raise ValueError("empty item list")
         branch = int(branch)
+        if branch < 2:
+            raise ValueError(
+                f"branch={branch}: a tree needs branch >= 2 (a "
+                "1-ary 'tree' cannot hold more than one item per "
+                "level)")
         height = 1
         while branch ** (height - 1) < n:
             height += 1

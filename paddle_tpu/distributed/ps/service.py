@@ -513,9 +513,10 @@ def _srv_graph_set_feat(name, ids, values, fname):
     return True
 
 
-def _srv_graph_get_feat(name, ids, fname, width):
+def _srv_graph_get_feat(name, ids, fname, width, default):
     with _GRAPH_LOCKS[name]:
         return _GRAPH_TABLES[name].get_node_feat(ids, fname,
+                                                 default=default,
                                                  width=width)
 
 
@@ -628,7 +629,7 @@ class GraphTableClient:
     def get_node_feat(self, ids, fname, default=0.0):
         width = self._width_of(fname)
         ids, futs = self._scatter(_srv_graph_get_feat, ids,
-                                  extra=(fname, width))
+                                  extra=(fname, width, default))
         out = np.full((len(ids),) + tuple(width), default, np.float32)
         for f, mask in futs.values():
             out[mask] = f.result()

@@ -279,8 +279,14 @@ class DistModel:
             from paddle_tpu.jit.save_load import load
 
             self._translated = load(cfg.model_path)
-            if (cfg.dp > 1 or cfg.mp > 1) \
-                    and self._translated._exported is not None:
+            if cfg.dp > 1 or cfg.mp > 1:
+                if self._translated._exported is None:
+                    raise ValueError(
+                        f"dp={cfg.dp} x mp={cfg.mp} serving needs an "
+                        "executable artifact (saved with input_spec); "
+                        f"{cfg.model_path} is weights-only — serving "
+                        "it single-device would silently discard the "
+                        "requested layout")
                 # saved on 1 device, served dp x mp: the outer pjit
                 # reshards using the artifact's recorded dist_specs
                 self._forward = _shard_translated(self._translated,

@@ -44,13 +44,14 @@ class EventItem:
     """Aggregate of every span sharing one name (reference
     profiler_statistic.py EventSummary items)."""
 
-    __slots__ = ("name", "call", "cpu_time", "max_cpu_time",
-                 "min_cpu_time", "device_time", "max_device_time",
-                 "min_device_time")
+    __slots__ = ("name", "cpu_call", "device_call", "cpu_time",
+                 "max_cpu_time", "min_cpu_time", "device_time",
+                 "max_device_time", "min_device_time")
 
     def __init__(self, name):
         self.name = name
-        self.call = 0
+        self.cpu_call = 0
+        self.device_call = 0
         self.cpu_time = 0.0
         self.max_cpu_time = 0.0
         self.min_cpu_time = float("inf")
@@ -59,25 +60,31 @@ class EventItem:
         self.min_device_time = float("inf")
 
     def add(self, dur_ms, device: bool):
-        self.call += 1
+        # per-kind call counts: one name can hold BOTH host spans
+        # (trace-time dispatches) and sync-timed device spans; a shared
+        # denominator would understate both averages
         if device:
+            self.device_call += 1
             self.device_time += dur_ms
             self.max_device_time = max(self.max_device_time, dur_ms)
             self.min_device_time = min(self.min_device_time, dur_ms)
         else:
+            self.cpu_call += 1
             self.cpu_time += dur_ms
             self.max_cpu_time = max(self.max_cpu_time, dur_ms)
             self.min_cpu_time = min(self.min_cpu_time, dur_ms)
 
     @property
+    def call(self):
+        return self.cpu_call + self.device_call
+
+    @property
     def avg_cpu_time(self):
-        n = max(1, self.call)
-        return self.cpu_time / n
+        return self.cpu_time / max(1, self.cpu_call)
 
     @property
     def avg_device_time(self):
-        n = max(1, self.call)
-        return self.device_time / n
+        return self.device_time / max(1, self.device_call)
 
     def _key(self, sorted_by: SortedKeys):
         return {

@@ -298,3 +298,15 @@ def test_foreign_axis_dist_specs_serve_replicated(tmp_path):
     cfg.set_dist_degrees(dp=2)
     out = infer.create_predictor(cfg).run([x])[0]
     np.testing.assert_allclose(out, plain, rtol=1e-5, atol=1e-6)
+
+
+def test_distmodel_weights_only_artifact_rejects_dist_degrees(tmp_path):
+    """A weights-only artifact (saved without input_spec) cannot honor
+    dp/mp>1 — DistModel must refuse loudly, not silently serve
+    single-device."""
+    net = _net()
+    path = str(tmp_path / "weights_only")
+    paddle.jit.save(net, path)  # no input_spec: no exported program
+    with pytest.raises(ValueError, match="weights-only"):
+        inference.DistModel(
+            inference.DistModelConfig(model_path=path, mp=2)).init()

@@ -132,12 +132,6 @@ class Coordinator:
                 return (self._strategy_of(cid), self.round_idx)
         if cmd == "push":
             cid, round_idx, state, n_samples = payload
-            if float(n_samples) <= 0:
-                # rejected at the door: a zero-weight update would make
-                # the FedAvg denominator 0 and wedge the round
-                raise ValueError(
-                    f"push from {cid!r} with n_samples={n_samples}; "
-                    "a client with no data must not JOIN the round")
             self._fold(cid, round_idx, state, n_samples)
             return True
         raise ValueError(f"unknown FL command {cmd!r}")
@@ -171,12 +165,18 @@ class Coordinator:
                 return
             folded = {c: self._round_updates[c] for c in joined}
             total = sum(n for _, n in folded.values())
-            new = {}
-            for k in self.global_state:
-                new[k] = sum(
-                    np.asarray(st[k], np.float32) * (n / total)
-                    for st, n in folded.values())
-            self.global_state = new
+            # a zero-sample push still counts as round PARTICIPATION
+            # (rejecting it would wedge the fold gate and deadlock the
+            # cohort) but contributes weight 0; if EVERY joined client
+            # pushed zero samples there is nothing to average — the
+            # global model stands and the round just advances
+            if total > 0:
+                new = {}
+                for k in self.global_state:
+                    new[k] = sum(
+                        np.asarray(st[k], np.float32) * (n / total)
+                        for st, n in folded.values())
+                self.global_state = new
             self._round_updates = {}
             self.round_idx += 1
         with self._round_done:

@@ -558,6 +558,11 @@ class GraphTableClient:
         self.name = name
         self._servers = _discover_servers()
         self._feat_width: dict = {}
+        self._ids_cache = None  # sorted global ids; invalidated on
+        #                         THIS client's mutations (another
+        #                         trainer's writes need a fresh client
+        #                         call after its own mutation, or
+        #                         refresh_node_ids())
         for s in self._servers:
             rpc.rpc_sync(s, _srv_graph_ensure, args=(name,))
 
@@ -582,6 +587,7 @@ class GraphTableClient:
         return ids, futs
 
     def add_graph_node(self, ids):
+        self._ids_cache = None
         _, futs = self._scatter(_srv_graph_add_nodes, ids)
         for f, _ in futs.values():
             f.result()
@@ -594,12 +600,14 @@ class GraphTableClient:
         dst = np.asarray(dst_ids, np.int64).ravel()
         w = (np.ones(len(src), np.float32) if weights is None
              else np.asarray(weights, np.float32).ravel())
+        self._ids_cache = None
         _, futs = self._scatter(_srv_graph_add_edges, src, dst, w)
         for f, _ in futs.values():
             f.result()
         self.add_graph_node(dst)
 
     def set_node_feat(self, ids, fname, values):
+        self._ids_cache = None  # a feature write registers its node
         vals = np.asarray(values)
         want = self._feat_width.setdefault(fname, vals.shape[1:])
         if vals.shape[1:] != want:
@@ -651,17 +659,32 @@ class GraphTableClient:
         return (out, wout) if need_weight else out
 
     def node_ids(self):
-        from paddle_tpu.distributed import rpc
+        if self._ids_cache is None:
+            from paddle_tpu.distributed import rpc
 
-        parts = [rpc.rpc_sync(s, _srv_graph_node_ids, args=(self.name,))
-                 for s in self._servers]
-        return np.sort(np.concatenate(parts)) if parts else \
-            np.empty(0, np.int64)
+            parts = [rpc.rpc_sync(s, _srv_graph_node_ids,
+                                  args=(self.name,))
+                     for s in self._servers]
+            ids = (np.sort(np.concatenate(parts)) if parts
+                   else np.empty(0, np.int64))
+            ids.setflags(write=False)
+            self._ids_cache = ids
+        return self._ids_cache
+
+    def refresh_node_ids(self):
+        """Drop the cached id list (another trainer mutated the
+        graph); the next node_ids() re-fetches from the servers."""
+        self._ids_cache = None
 
     def random_sample_nodes(self, n, seed=0):
         from .graph_table import uniform_sample_ids
 
         return uniform_sample_ids(self.node_ids(), n, seed)
+
+    def pull_graph_list(self, start, size):
+        """Deterministic node-id window over the sorted global id list
+        (same contract as GraphTable.pull_graph_list)."""
+        return self.node_ids()[start:start + size]
 
     def stats(self):
         from paddle_tpu.distributed import rpc

@@ -42,6 +42,7 @@ def _graph_mode(service, tid, out_file):
     result = {
         "stats": st,
         "other_neighbors": sorted(set(map(int, nbrs.ravel()))),
+        "graph_window": [int(i) for i in g.pull_graph_list(1, 3)],
         "other_feat": other_feat.tolist(),
     }
     if out_file:

@@ -215,3 +215,25 @@ def test_waited_client_push_cannot_contaminate_round():
         np.testing.assert_allclose(coord.global_state["w"], [5.0])
     finally:
         coord.close()
+
+
+def test_zero_sample_push_participates_without_weight():
+    """An empty-shard client's n_samples=0 push counts as round
+    participation (no deadlock) but contributes nothing to the
+    average; an all-zero round advances with the model unchanged."""
+    coord = Coordinator({"w": np.zeros(1)},
+                        selector=ClientSelector(max_rounds=2))
+    try:
+        c0 = FLClient(coord.endpoint, 0)
+        c1 = FLClient(coord.endpoint, 1)
+        c0.push(0, {"w": np.array([7.0])}, 10)
+        c1.push(0, {"w": np.array([999.0])}, 0)   # empty shard
+        assert coord.wait_rounds(1) == 1
+        np.testing.assert_allclose(coord.global_state["w"], [7.0])
+        # all-zero round: model stands, round still advances
+        c0.push(1, {"w": np.array([1.0])}, 0)
+        c1.push(1, {"w": np.array([2.0])}, 0)
+        assert coord.wait_rounds(2) == 2
+        np.testing.assert_allclose(coord.global_state["w"], [7.0])
+    finally:
+        coord.close()

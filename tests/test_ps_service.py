@@ -166,5 +166,7 @@ def test_ps_service_graph_table(tmp_path):
         assert r["stats"]["nshards"] == 2
         # the OTHER trainer's source node links to {99, 110+(1-tid)}
         assert set(r["other_neighbors"]) == {99, 110 + (1 - tid)}
+        # sorted global ids {10,11,20,21,99,110,111}: window [1,4)
+        assert r["graph_window"] == [11, 20, 21]
         # and carries the feature the other trainer wrote
         assert r["other_feat"] == [[float(1 - tid), 1.0]]

@@ -132,7 +132,14 @@ class Coordinator:
                 return (self._strategy_of(cid), self.round_idx)
         if cmd == "push":
             cid, round_idx, state, n_samples = payload
-            self._fold(cid, round_idx, state, n_samples)
+            n = float(n_samples)
+            if not np.isfinite(n) or n < 0:
+                # zero is legitimate (participation without weight);
+                # negative/NaN weights would corrupt the average
+                raise ValueError(
+                    f"push from {cid!r} with invalid "
+                    f"n_samples={n_samples}")
+            self._fold(cid, round_idx, state, n)
             return True
         raise ValueError(f"unknown FL command {cmd!r}")
 

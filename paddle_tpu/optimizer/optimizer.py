@@ -164,6 +164,13 @@ class Optimizer:
                 if key in state and state[key] is not None:
                     v = state[key]
                     arr = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+                    # coerce to THIS optimizer's configured storage
+                    # dtype: a checkpoint saved under a different
+                    # multi_precision setting must not silently pin the
+                    # old moment dtype (the update casts back to the
+                    # accumulator dtype every step)
+                    if lst[i] is not None and arr.dtype != lst[i].dtype:
+                        arr = arr.astype(lst[i].dtype)
                     lst[i] = arr
         if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state["LR_Scheduler"])

@@ -18,14 +18,15 @@ from .coordinator import (ClientSelector, ClientSelectorBase,
                           Coordinator, FLClient, FLStrategy)
 from .graph_table import GraphShard, GraphTable
 from .index_dataset import Index, TreeIndex
-from .service import (Communicator, TableClient, init_ps_rpc, is_server,
+from .service import (Communicator, GraphTableClient, TableClient,
+                      init_ps_rpc, is_server,
                       is_worker, run_server, stop_servers)
 from .table import (MemorySparseTable, SparseAdagradRule, SparseSGDRule,
                     SSDSparseTable)
 
 __all__ = ["Coordinator", "FLClient", "FLStrategy",
            "ClientSelector", "ClientSelectorBase",
-           "GraphTable", "GraphShard", "Index", "TreeIndex",
+           "GraphTable", "GraphShard", "GraphTableClient", "Index", "TreeIndex",
            "MemorySparseTable", "SSDSparseTable", "SparseAdagradRule",
            "SparseSGDRule",
            "DistributedEmbedding", "service", "TableClient",

@@ -139,6 +139,13 @@ class Coordinator:
                 raise ValueError(
                     f"push from {cid!r} with invalid "
                     f"n_samples={n_samples}")
+            for k, v in state.items():
+                if not np.isfinite(np.asarray(v, np.float32)).all():
+                    # a diverged client must not poison every future
+                    # round's average with NaN/Inf weights
+                    raise ValueError(
+                        f"push from {cid!r}: state[{k!r}] contains "
+                        "non-finite values (diverged local training?)")
             self._fold(cid, round_idx, state, n)
             return True
         raise ValueError(f"unknown FL command {cmd!r}")

@@ -76,8 +76,7 @@ class TreeIndex(Index):
         t = cls(name)
         t._branch = branch
         t._height = height
-        first_leaf = (branch ** (height - 1) - 1) // (branch - 1) \
-            if branch > 1 else height - 1
+        first_leaf = (branch ** (height - 1) - 1) // (branch - 1)
         leaf_codes = first_leaf + np.arange(n)
         # code -> (id, is_leaf, prob) maps, ancestors get synthetic ids
         codes = [leaf_codes]
@@ -150,11 +149,9 @@ class TreeIndex(Index):
                           np.uint64)
 
     def _layer_codes_scan(self, level):
-        if self._branch > 1:
-            lo = (self._branch ** level - 1) // (self._branch - 1)
-            hi = (self._branch ** (level + 1) - 1) // (self._branch - 1)
-        else:
-            lo, hi = level, level + 1
+        # branch >= 2 guaranteed by from_items' validation
+        lo = (self._branch ** level - 1) // (self._branch - 1)
+        hi = (self._branch ** (level + 1) - 1) // (self._branch - 1)
         mask = (self._codes >= lo) & (self._codes < hi)
         return np.sort(self._codes[mask])
 

@@ -528,7 +528,26 @@ def _srv_graph_feat_width(name, fname):
         return None if w is None else tuple(w)
 
 
+def _srv_graph_register_width(name, fname, width):
+    """Register `fname`'s shape on THIS server before any rows land —
+    called on EVERY server at set time, so two writers fixing
+    different widths for the same feature collide loudly at the second
+    write instead of poisoning a later read with a broadcast error."""
+    with _GRAPH_LOCKS[name]:
+        have = _GRAPH_TABLES[name]._feat_width.setdefault(
+            fname, tuple(width))
+        if tuple(have) != tuple(width):
+            raise ValueError(
+                f"feature {fname!r} is fixed at shape {tuple(have)} "
+                f"on this server; a writer tried {tuple(width)}")
+    return True
+
+
 def _srv_graph_sample_neighbors(name, ids, k, seed, need_weight):
+    # fold the server index into the seed: every server replaying the
+    # SAME RandomState(seed) would make cross-shard samples perfectly
+    # correlated (identical pick-index patterns for equal-degree nodes)
+    seed = (int(seed) + 1000003 * server_index()) % (2 ** 31)
     with _GRAPH_LOCKS[name]:
         return _GRAPH_TABLES[name].random_sample_neighbors(
             ids, k, seed=seed, need_weight=need_weight)
@@ -598,8 +617,14 @@ class GraphTableClient:
         register on their own servers."""
         src = np.asarray(src_ids, np.int64).ravel()
         dst = np.asarray(dst_ids, np.int64).ravel()
+        if len(src) != len(dst):
+            raise ValueError(f"src/dst length mismatch: "
+                             f"{len(src)} vs {len(dst)}")
         w = (np.ones(len(src), np.float32) if weights is None
              else np.asarray(weights, np.float32).ravel())
+        if len(w) != len(src):
+            raise ValueError(f"weights length mismatch: "
+                             f"{len(w)} vs {len(src)} edges")
         self._ids_cache = None
         _, futs = self._scatter(_srv_graph_add_edges, src, dst, w)
         for f, _ in futs.values():
@@ -607,12 +632,24 @@ class GraphTableClient:
         self.add_graph_node(dst)
 
     def set_node_feat(self, ids, fname, values):
+        from paddle_tpu.distributed import rpc
+
         self._ids_cache = None  # a feature write registers its node
         vals = np.asarray(values)
+        if len(vals) != len(np.asarray(ids).ravel()):
+            raise ValueError(f"values length mismatch: {len(vals)} vs "
+                             f"{len(np.asarray(ids).ravel())} ids")
         want = self._feat_width.setdefault(fname, vals.shape[1:])
         if vals.shape[1:] != want:
             raise ValueError(f"feature {fname!r} is fixed at shape "
                              f"{want}; got {vals.shape[1:]}")
+        # width registers on EVERY server first (not just the owners)
+        # so concurrent writers with conflicting widths collide here,
+        # loudly, instead of at a later read
+        for f in [rpc.rpc_async(s, _srv_graph_register_width,
+                                args=(self.name, fname, tuple(want)))
+                  for s in self._servers]:
+            f.result()
         _, futs = self._scatter(_srv_graph_set_feat, ids, vals,
                                 extra=(fname,))
         # NOTE extra goes AFTER per-id cols: server signature is
@@ -622,16 +659,18 @@ class GraphTableClient:
 
     def _width_of(self, fname):
         """Feature width: locally registered, else learned from the
-        servers (a pure-reader client never called set_node_feat)."""
+        servers (a pure-reader client never called set_node_feat).
+        One parallel round-trip, not S sequential ones."""
         if fname not in self._feat_width:
             from paddle_tpu.distributed import rpc
 
-            for s in self._servers:
-                w = rpc.rpc_sync(s, _srv_graph_feat_width,
-                                 args=(self.name, fname))
+            futs = [rpc.rpc_async(s, _srv_graph_feat_width,
+                                  args=(self.name, fname))
+                    for s in self._servers]
+            for f in futs:
+                w = f.result()
                 if w is not None:
-                    self._feat_width[fname] = tuple(w)
-                    break
+                    self._feat_width.setdefault(fname, tuple(w))
         return self._feat_width.get(fname, (1,))
 
     def get_node_feat(self, ids, fname, default=0.0):
@@ -662,11 +701,13 @@ class GraphTableClient:
         if self._ids_cache is None:
             from paddle_tpu.distributed import rpc
 
-            parts = [rpc.rpc_sync(s, _srv_graph_node_ids,
-                                  args=(self.name,))
-                     for s in self._servers]
-            ids = (np.sort(np.concatenate(parts)) if parts
-                   else np.empty(0, np.int64))
+            # parallel fan-out (servers guaranteed non-empty by
+            # _discover_servers)
+            parts = [f.result() for f in
+                     [rpc.rpc_async(s, _srv_graph_node_ids,
+                                    args=(self.name,))
+                      for s in self._servers]]
+            ids = np.sort(np.concatenate(parts))
             ids.setflags(write=False)
             self._ids_cache = ids
         return self._ids_cache
@@ -689,8 +730,9 @@ class GraphTableClient:
     def stats(self):
         from paddle_tpu.distributed import rpc
 
-        per = [rpc.rpc_sync(s, _srv_graph_stats, args=(self.name,))
-               for s in self._servers]
+        per = [f.result() for f in
+               [rpc.rpc_async(s, _srv_graph_stats, args=(self.name,))
+                for s in self._servers]]
         return {"nodes": sum(p["nodes"] for p in per),
                 "edges": sum(p["edges"] for p in per),
                 "nshards": len(self._servers)}

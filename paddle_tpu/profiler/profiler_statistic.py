@@ -158,16 +158,21 @@ def build_table(data: StatisticData,
         if not items:
             return
         w = max(12, min(44, max(len(i.name) for i in items) + 2))
-        hdr = (f"{'Name':<{w}} {'Calls':>7} "
+        hdr = (f"{'Name':<{w}} {'Calls':>9} "
                f"{'CPU Total':>11} {'CPU Avg':>9} {'CPU Max':>9} "
                f"{'Dev Total':>11} {'Dev Avg':>9}")
         lines.append("-" * len(hdr))
         lines.append(f"[{title}]  (times in {time_unit}, "
-                     f"sorted by {sorted_by.name})")
+                     f"sorted by {sorted_by.name}; mixed-kind rows "
+                     "show Calls as cpu/dev — each Avg divides by ITS "
+                     "kind's count)")
         lines.append(hdr)
         for it in items[:row_limit]:
+            calls = (f"{it.cpu_call}/{it.device_call}"
+                     if it.cpu_call and it.device_call
+                     else str(it.call))
             lines.append(
-                f"{it.name[:w]:<{w}} {it.call:>7} "
+                f"{it.name[:w]:<{w}} {calls:>9} "
                 f"{_fmt(it.cpu_time, unit_div):>11} "
                 f"{_fmt(it.avg_cpu_time, unit_div):>9} "
                 f"{_fmt(it.max_cpu_time, unit_div):>9} "

@@ -169,6 +169,10 @@ def suite():
         jax.jit(lambda a: a.astype(jnp.float32).sum()), (big,), None)
 
     cases["gpt_decode_kv_32tok"] = _decode_case()
+    # heavy inference rows build lazily: suite() stays cheap to enumerate
+    # (CPU CI imports it), run() resolves the callables when measuring
+    cases["gpt_decode_kv_350m"] = _decode_350m_case
+    cases["gpt_engine_offered_load"] = _engine_offered_load_case()
     return cases
 
 
@@ -206,17 +210,117 @@ def _decode_case():
     return (decode, (fuzz,), flops, {"tokens": B * new_tokens})
 
 
+def _decode_350m_case():
+    """The VERDICT r5 next-#9 representative decode row: GPT-medium
+    (~350M params — the published GPT-2-medium shape) decoding 256 new
+    tokens per call for a batch of 8 through the compiled fixed-buffer
+    lax.while_loop KV-cache path, timed inside _timeit's dynamic-N
+    fori_loop like every other row. Supersedes the 21M 32-token toy as
+    the single-program decode health number (the toy stays for cheap
+    CPU coverage of the code path). Same float-fuzz prompt trick as
+    _decode_case so nothing is loop-invariant."""
+    import numpy as np
+
+    import paddle_tpu  # noqa: F401  (registers ops)
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    B, S0, L, vocab = 8, 128, 384, 50304
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=1024, num_layers=24,
+                    num_heads=16, max_seq_len=L)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    new_tokens = L - S0
+
+    def decode(fuzz):
+        ids = (jnp.abs(fuzz).astype(jnp.int32) % vocab)
+        toks = model.generate(Tensor._wrap(ids), max_length=L,
+                              use_cache=True)
+        return toks._array.astype(jnp.float32)
+
+    fuzz = jnp.abs(_rand((B, S0), jnp.float32, seed=13)) * 9973.0
+    flops = 2 * n_params * B * new_tokens
+    return (decode, (fuzz,), flops, {"tokens": B * new_tokens})
+
+
+def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
+                              block_size=16, prefill_buckets=None,
+                              seed=0):
+    """Engine-level offered-load row: the continuous-batching engine
+    (paged KV cache + slot scheduler, inference/engine.py) serving a
+    mixed trace of prompts/output lengths; the metric is AGGREGATE new
+    tokens per wall-clock second — the serving-health number the gate
+    tracks from this PR on. Self-timed (the scheduler loop is
+    host-driven admission between compiled iterations, so _timeit's
+    in-graph fori_loop doesn't apply): compile is excluded by warming
+    every prefill bucket + the decode step on a throwaway trace first.
+    Returns a zero-arg runner producing the result record (run()
+    resolves it); tests call it with a tiny config."""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.inference import GenerationEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        reqs = requests or [
+            (int(rng.randint(24, 193)), int(rng.randint(32, 129)))
+            for _ in range(24)]                # (prompt_len, max_new)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        buckets = prefill_buckets or tuple(
+            b for b in (32, 64, 128, 256, cfg.max_seq_len)
+            if b <= cfg.max_seq_len)
+        engine = GenerationEngine(model, num_slots=num_slots,
+                                  block_size=block_size,
+                                  prefill_buckets=buckets)
+        # warm every compiled program the trace will hit (bucketed
+        # prefill per bucket + the one decode step), then measure
+        for b in sorted({engine._bucket_for(p) for p, _ in reqs}):
+            warm_len = min(b, engine.max_model_len - 2)
+            engine.add_request(rng.randint(0, cfg.vocab_size, warm_len),
+                               max_new_tokens=2)
+        engine.run()
+        base = engine.tokens_generated
+        for plen, max_new in reqs:
+            engine.add_request(rng.randint(0, cfg.vocab_size, plen),
+                               max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        out = engine.run()
+        dt = time.perf_counter() - t0
+        new_toks = engine.tokens_generated - base
+        assert len(out) == len(reqs)
+        return {"ms": round(dt * 1e3, 1),
+                "tokens_per_s": round(new_toks / dt),
+                "requests": len(reqs)}
+
+    return run_bench
+
+
 def run():
     results = {}
     for name, case in suite().items():
-        fn, args, flops = case[:3]
-        extra = case[3] if len(case) > 3 else {}
-        ms = _timeit(fn, *args)
-        rec = {"op": name, "ms": round(ms, 4)}
-        if flops:
-            rec["tflops"] = round(flops / (ms / 1e3) / 1e12, 2)
-        if extra.get("tokens"):
-            rec["tokens_per_s"] = round(extra["tokens"] / (ms / 1e3))
+        if callable(case):                 # lazy heavy row: build now
+            case = case()
+        if isinstance(case, dict):         # self-timed (engine) row
+            rec = {"op": name, **case}
+        else:
+            fn, args, flops = case[:3]
+            extra = case[3] if len(case) > 3 else {}
+            ms = _timeit(fn, *args)
+            rec = {"op": name, "ms": round(ms, 4)}
+            if flops:
+                rec["tflops"] = round(flops / (ms / 1e3) / 1e12, 2)
+            if extra.get("tokens"):
+                rec["tokens_per_s"] = round(extra["tokens"] / (ms / 1e3))
         results[name] = rec
         print(json.dumps(rec), flush=True)
     return results

@@ -230,18 +230,24 @@ def _elastic_resize(args, emaster):
     """Resize the pod to the registry's live set at a restart boundary:
     launcher-owned survivors (failed members already left) plus any
     externally rejoined members, clamped to [--elastic-min,
-    --elastic-max]. External joiners are absorbed (their registration
-    is consumed) — the relaunch spawns their capacity as local ranks."""
+    --elastic-max]. ONLY the joiners actually absorbed into the new
+    world size have their registration consumed (the relaunch spawns
+    their capacity as local ranks); a joiner the elastic_max clamp left
+    out keeps its TTL lease — its heartbeat agent stays live and it is
+    picked up at a later restart boundary instead of silently
+    retiring."""
     node_mode = bool(args.nprocs_per_node)
     current = args.nnodes if node_mode else args.nprocs
     live = emaster.live()
-    joiners = [m for m, info in live.items() if info.get("_external")]
+    joiners = sorted(m for m, info in live.items()
+                     if info.get("_external"))
     survivors = len(live) - len(joiners)
     if len(live) == 0:
         return  # every member died: plain fixed-size restart
     new = max(min(survivors + len(joiners), args.elastic_max),
               args.elastic_min)
-    for j in joiners:
+    absorbed = max(0, min(len(joiners), new - survivors))
+    for j in joiners[:absorbed]:
         emaster.leave(j)
     if new == current:
         return

@@ -12,6 +12,14 @@ FedAvg (sample-count-weighted average — the role the reference's PS
 push/pull plays for its FL workers). Everything numpy host-side; the
 local training itself runs wherever the client runs it (TPU step, CPU
 test).
+
+SECURITY: the wire format is the rpc tier's unauthenticated pickle
+framing — `pickle.loads` on every message, in BOTH directions. Run the
+coordinator on loopback or a trusted network segment ONLY (the default
+bind is 127.0.0.1); never expose the port to semi-trusted FL clients
+across a boundary you don't control. Authenticated JSON+ndarray framing
+(elastic.py's choice for exactly this reason) is the upgrade path if
+that deployment shape is ever needed. See DESIGN_DECISIONS.md.
 """
 from __future__ import annotations
 
@@ -139,8 +147,27 @@ class Coordinator:
                 raise ValueError(
                     f"push from {cid!r} with invalid "
                     f"n_samples={n_samples}")
+            # key/shape validation BEFORE the update is stored: a
+            # malformed push failing inside the fold (after the
+            # all-pushed gate) would leave _round_updates populated and
+            # the round index stuck — wedging every OTHER client's poll
+            # loop. Error the bad client instead; the round stays
+            # foldable.
+            missing = set(self.global_state) - set(state)
+            extra = set(state) - set(self.global_state)
+            if missing or extra:
+                raise ValueError(
+                    f"push from {cid!r} does not match global_state: "
+                    f"missing keys {sorted(missing)}, unknown keys "
+                    f"{sorted(extra)}")
             for k, v in state.items():
-                if not np.isfinite(np.asarray(v, np.float32)).all():
+                arr = np.asarray(v, np.float32)
+                want = self.global_state[k].shape
+                if arr.shape != want:
+                    raise ValueError(
+                        f"push from {cid!r}: state[{k!r}] has shape "
+                        f"{arr.shape}, global_state expects {want}")
+                if not np.isfinite(arr).all():
                     # a diverged client must not poison every future
                     # round's average with NaN/Inf weights
                     raise ValueError(

@@ -19,9 +19,12 @@ from typing import Optional, Sequence
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.inference.engine import (GenerationEngine, PagedKVCache,
+                                         Request)
 
 __all__ = ["Config", "Predictor", "create_predictor", "DistModel",
-           "DistModelConfig"]
+           "DistModelConfig", "GenerationEngine", "PagedKVCache",
+           "Request"]
 
 
 def _stream_micro_batches(forward, ins, mbs, pad_to=1):

@@ -1,5 +1,6 @@
-from .api import InputSpec, TrainStep, not_to_static, to_static
+from .api import (InputSpec, TrainStep, count_traces, expect_traces,
+                  not_to_static, to_static)
 from .save_load import TranslatedLayer, load, save
 
 __all__ = ["to_static", "not_to_static", "TrainStep", "InputSpec", "save",
-           "load", "TranslatedLayer"]
+           "load", "TranslatedLayer", "count_traces", "expect_traces"]

@@ -353,6 +353,43 @@ def not_to_static(fn):
     return fn
 
 
+def count_traces(fn):
+    """Trace-count probe: wrap a python callable BEFORE handing it to
+    jax.jit so every retrace (jit cache miss) increments `.traces` —
+    jax re-invokes the python function exactly once per new
+    (shape, dtype) signature. CI uses this to PROVE a steady-state
+    compiled path stays compiled (e.g. the generation engine's decode
+    step must trace once, not once per request), instead of inferring
+    it from wall-clock noise."""
+
+    @functools.wraps(fn)
+    def counted(*args, **kwargs):
+        counted.traces += 1
+        return fn(*args, **kwargs)
+
+    counted.traces = 0
+    return counted
+
+
+@contextmanager
+def expect_traces(counted, n):
+    """Assertion helper over a `count_traces` probe: the wrapped block
+    must trigger EXACTLY n new traces (n=0 asserts no recompiles —
+    the steady-state-decode CI contract)."""
+    if not hasattr(counted, "traces"):
+        raise TypeError("expect_traces needs a count_traces-wrapped "
+                        "callable (missing .traces)")
+    before = counted.traces
+    yield
+    got = counted.traces - before
+    if got != n:
+        raise AssertionError(
+            f"expected {n} trace(s) of {getattr(counted, '__name__', counted)} "
+            f"in this block, observed {got} — a compiled path is "
+            "retracing (shape/dtype drift or python-object cache-key "
+            "churn)")
+
+
 def dedup_params(params):
     """Identity-dedup for parameter/buffer lists: a layer registered
     under two parents (shared submodules) must not produce a

@@ -127,9 +127,11 @@ class GPTAttention(nn.Layer):
     def forward_decode(self, x, kcache, vcache, pos):
         """One-token decode against a FIXED-size cache (the jit-friendly
         KV cache: no growing concat). x [B,1,H]; kcache/vcache
-        [B,L,heads,D]; pos may be a traced scalar. Writes this token's
-        k/v at `pos`, attends over positions <= pos (additive mask),
-        returns (out [B,1,H], new_kcache, new_vcache)."""
+        [B,L,heads,D]; pos may be a traced scalar — or a [B] vector of
+        per-row positions (the continuous-batching shape: each slot sits
+        at its own depth). Writes this token's k/v at `pos`, attends
+        over positions <= pos (additive mask), returns
+        (out [B,1,H], new_kcache, new_vcache)."""
         import paddle_tpu as paddle
 
         B, S, H = x.shape  # S == 1
@@ -137,20 +139,40 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)
         qkv = mp.reshape(qkv, [B, 1, 3, self.num_heads, self.head_dim])
         q, k, v = mp.unbind(qkv, axis=2)        # [B,1,heads,D]
-        slot = (paddle.arange(L) == pos).reshape([1, L, 1, 1])
+        per_row = getattr(pos, "ndim", 0) == 1  # [B] vector of positions
+        posv = mp.reshape(pos, [B, 1]) if per_row else pos
+        slot = (paddle.arange(L).unsqueeze(0) == posv).reshape(
+            [-1, L, 1, 1])                      # [B or 1, L, 1, 1]
         kcache = paddle.where(slot, k, kcache)
         vcache = paddle.where(slot, v, vcache)
         # additive mask over the buffer: future slots (and the padded
         # tail) are -inf
-        allowed = (paddle.arange(L) <= pos)
+        allowed = (paddle.arange(L).unsqueeze(0) <= posv)  # [B or 1, L]
         attn_mask = paddle.where(
-            allowed, paddle.zeros([L]),
-            paddle.full([L], -1e30)).reshape([1, 1, 1, L])
+            allowed, paddle.zeros([1, L]),
+            paddle.full([1, L], -1e30)).reshape([-1, 1, 1, L])
         out = F.scaled_dot_product_attention(
             q, kcache, vcache, attn_mask=attn_mask, dropout_p=0.0,
             training=False)
         return (self.out_proj(mp.reshape(out, [B, 1, H])), kcache,
                 vcache)
+
+    def forward_decode_paged(self, x, kpool, vpool, layer_idx,
+                             block_tables, positions):
+        """Batched one-token decode against the GLOBAL paged KV pool
+        (the continuous-batching engine's layer step). x [slots,1,H];
+        kpool/vpool [layers, num_blocks, block_size, heads, D];
+        positions [slots] per-slot absolute positions; block_tables
+        [slots, max_blocks]. Returns (out, new_kpool, new_vpool)."""
+        from paddle_tpu.ops.paged_attention import paged_attention_step
+
+        B, S, H = x.shape  # S == 1
+        qkv = self.qkv_proj(x)
+        qkv = mp.reshape(qkv, [B, 1, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)
+        out, kpool, vpool = paged_attention_step(
+            q, k, v, kpool, vpool, layer_idx, block_tables, positions)
+        return self.out_proj(mp.reshape(out, [B, 1, H])), kpool, vpool
 
 
 class GPTMLP(nn.Layer):
@@ -211,6 +233,14 @@ class GPTBlock(nn.Layer):
         x = x + a
         return x + self.mlp(self.ln2(x)), kcache, vcache
 
+    def forward_decode_paged(self, x, kpool, vpool, layer_idx,
+                             block_tables, positions):
+        a, kpool, vpool = self.attn.forward_decode_paged(
+            self.ln1(x), kpool, vpool, layer_idx, block_tables,
+            positions)
+        x = x + a
+        return x + self.mlp(self.ln2(x)), kpool, vpool
+
 
 class GPTModel(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -252,12 +282,17 @@ class GPTModel(nn.Layer):
         return self.ln_f(h), mp.stack(ks, axis=0), mp.stack(vs, axis=0)
 
     def forward_decode(self, token_ids, pos, kstack, vstack):
-        """One decode step: token_ids [B,1], pos scalar (may be traced),
-        kstack/vstack [num_layers, B, L, heads, D]. Returns
+        """One decode step: token_ids [B,1], pos scalar (may be traced)
+        or [B] per-row positions, kstack/vstack
+        [num_layers, B, L, heads, D]. Returns
         (hidden [B,1,H], new_kstack, new_vstack)."""
-        h = self.wte(token_ids) + self.wpe(
-            mp.reshape(pos.astype("int32") if hasattr(pos, "astype")
-                       else paddle.to_tensor(pos, dtype="int32"), [1]))
+        pos_t = pos.astype("int32") if hasattr(pos, "astype") \
+            else paddle.to_tensor(pos, dtype="int32")
+        if getattr(pos_t, "ndim", 0) == 1:      # per-row: [B] -> [B,1,H]
+            pemb = self.wpe(pos_t).unsqueeze(1)
+        else:
+            pemb = self.wpe(mp.reshape(pos_t, [1]))
+        h = self.wte(token_ids) + pemb
         nks, nvs = [], []
         for i, blk in enumerate(self.blocks):
             h, nk, nv = blk.forward_decode(h, kstack[i], vstack[i], pos)
@@ -265,6 +300,23 @@ class GPTModel(nn.Layer):
             nvs.append(nv)
         return (self.ln_f(h), mp.stack(nks, axis=0),
                 mp.stack(nvs, axis=0))
+
+    def forward_decode_paged(self, token_ids, positions, kpool, vpool,
+                             block_tables):
+        """Batched decode step over the paged pool (continuous-batching
+        engine path): token_ids [slots,1], positions [slots] int32
+        per-slot absolute positions, kpool/vpool
+        [num_layers, num_blocks, block_size, heads, D], block_tables
+        [slots, max_blocks]. Returns (hidden [slots,1,H], new_kpool,
+        new_vpool) — pool updates chain functionally through the layers
+        and alias in place under the engine's donated compiled step."""
+        pos_t = positions.astype("int32") if hasattr(positions, "astype") \
+            else paddle.to_tensor(positions, dtype="int32")
+        h = self.wte(token_ids) + self.wpe(pos_t).unsqueeze(1)
+        for i, blk in enumerate(self.blocks):
+            h, kpool, vpool = blk.forward_decode_paged(
+                h, kpool, vpool, i, block_tables, pos_t)
+        return self.ln_f(h), kpool, vpool
 
 
 def _transformed_method(cls, name):

@@ -18,6 +18,7 @@ from . import (  # noqa: F401
     manipulation,
     math,
     nn_ops,
+    paged_attention,
     random_ops,
     reduction,
 )

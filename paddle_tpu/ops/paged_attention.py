@@ -1,0 +1,132 @@
+"""Paged KV-cache attention helpers — the op tier under the
+continuous-batching generation engine (paddle_tpu/inference/engine.py).
+
+vLLM-PagedAttention-style layout, XLA edition: each layer's KV cache is
+a global pool `[num_layers, num_blocks, block_size, heads, head_dim]`
+shared by every in-flight request; a per-slot block table maps logical
+token positions to pool blocks, so requests of different lengths share
+HBM without per-request max-seq allocation. Block 0 is reserved as the
+NULL block: idle decode slots and padded prefill positions write there,
+and no allocator ever hands it out, so garbage writes can never alias a
+live request's context.
+
+Implementation notes (the dense-gather fallback):
+- the per-step attention GATHERS each slot's blocks back into a
+  contiguous `[slots, max_len, heads, head_dim]` view and runs plain
+  masked attention — O(max_len) HBM traffic per slot per step, which is
+  exactly what a fused Pallas paged-attention kernel (one core per
+  slot, block-table-driven async copies HBM->VMEM) would remove. The
+  helper is the single seam where that kernel slots in; everything
+  above it (engine, model, tests) is layout-agnostic.
+- functional `.at[].set` writes chain through the layer stack; under
+  the engine's donated compiled step XLA aliases them in place, so the
+  pool is updated in HBM, not copied per layer.
+- scatter/gather indices are per-slot vectors: one program serves any
+  mix of slot positions (shape-stable steady-state decode — no
+  per-request recompiles).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .dispatch import apply, as_tensor
+
+__all__ = ["paged_attention_step", "paged_prefill_write",
+           "dense_gather_reference"]
+
+
+def paged_attention_step(q, k, v, kpool, vpool, layer, block_tables,
+                         positions, scale=None):
+    """One batched decode step against the paged cache, for one layer.
+
+    q/k/v: `[slots, 1, heads, head_dim]` — this step's projections.
+    kpool/vpool: `[layers, num_blocks, block_size, heads, head_dim]`.
+    layer: python int (static) — which layer's pool plane to use.
+    block_tables: `[slots, max_blocks]` int32 pool-block ids per slot.
+    positions: `[slots]` int32 — the incoming token's absolute position
+    per slot (its write address; attention covers positions <= it).
+
+    Writes k/v at `(block_tables[s, pos//bs], pos%bs)` per slot, then
+    attends q over the slot's gathered context. Idle slots are encoded
+    by the caller as (position 0, all-null table): they write into the
+    null block and attend garbage, and the engine discards their token.
+    Returns `(out [slots,1,heads,head_dim], new_kpool, new_vpool)`.
+    """
+    q, k, v = as_tensor(q), as_tensor(k), as_tensor(v)
+    kpool, vpool = as_tensor(kpool), as_tensor(vpool)
+    block_tables, positions = as_tensor(block_tables), as_tensor(positions)
+
+    def fn(qa, ka, va, kp, vp, bt, pos):
+        B = qa.shape[0]
+        bs = kp.shape[2]
+        bid = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
+        off = pos % bs
+        kp = kp.at[layer, bid, off].set(ka[:, 0])
+        vp = vp.at[layer, bid, off].set(va[:, 0])
+        # gather the slot's context back contiguous (the part a Pallas
+        # paged kernel replaces with block-table-driven VMEM copies)
+        keys = kp[layer][bt]      # [B, max_blocks, bs, heads, D]
+        vals = vp[layer][bt]
+        T = bt.shape[1] * bs
+        keys = keys.reshape(B, T, keys.shape[3], keys.shape[4])
+        vals = vals.reshape(B, T, vals.shape[3], vals.shape[4])
+        d = qa.shape[-1]
+        s = scale if scale is not None else 1.0 / np.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qa, keys,
+                            preferred_element_type=jnp.float32) * s
+        allowed = jnp.arange(T)[None, :] <= pos[:, None]     # [B, T]
+        logits = jnp.where(allowed[:, None, None, :], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
+        return out, kp, vp
+
+    return apply("paged_attention_step", fn, q, k, v, kpool, vpool,
+                 block_tables, positions)
+
+
+def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):
+    """Scatter a prefilled prompt's per-layer k/v into the pools.
+
+    kstack/vstack: `[layers, 1, S, heads, head_dim]` from
+    `GPTModel.forward_prefill` over the (bucket-padded) prompt.
+    block_row: `[max_blocks]` int32 — the slot's block table.
+    plen: true prompt length (may be traced — one compiled program per
+    bucket size S, shared across every prompt length in the bucket).
+
+    Positions >= plen (bucket padding) are routed to the null block 0,
+    so padding never lands in allocated blocks. Returns the updated
+    `(kpool, vpool)`.
+    """
+    kpool, vpool = as_tensor(kpool), as_tensor(vpool)
+    kstack, vstack = as_tensor(kstack), as_tensor(vstack)
+    block_row, plen = as_tensor(block_row), as_tensor(plen)
+
+    def fn(kp, vp, ks, vs, row, n):
+        S = ks.shape[2]
+        bs = kp.shape[2]
+        pos = jnp.arange(S)
+        bid = jnp.where(pos < n, row[pos // bs], 0)
+        off = pos % bs
+        kp = kp.at[:, bid, off].set(ks[:, 0])    # [layers, S, heads, D]
+        vp = vp.at[:, bid, off].set(vs[:, 0])
+        return kp, vp
+
+    return apply("paged_prefill_write", fn, kpool, vpool, kstack, vstack,
+                 block_row, plen)
+
+
+def dense_gather_reference(kpool, vpool, layer, block_row, length):
+    """Parity probe: reassemble one slot's first `length` cached k/v
+    rows from the pools into dense `[length, heads, head_dim]` arrays
+    (host-side, concrete values). Tests compare this against the dense
+    fixed-buffer cache the single-request decode path carries."""
+    kp = np.asarray(as_tensor(kpool)._array)[layer]
+    vp = np.asarray(as_tensor(vpool)._array)[layer]
+    row = np.asarray(as_tensor(block_row)._array)
+    bs = kp.shape[1]
+    pos = np.arange(int(length))
+    return (kp[row[pos // bs], pos % bs],
+            vp[row[pos // bs], pos % bs])

@@ -262,24 +262,72 @@ class QATConv2D(nn.Layer):
 class _QuantedBase(nn.Layer):
     """Shared converted-layer state: per-channel int8 weight + scale
     registered as buffers (so the converted model jit.saves with its
-    quantized state) and the PTQ-calibrated activation grid."""
+    quantized state) and the PTQ-calibrated activation grid.
 
-    def __init__(self, weight, axis, act_scale):
+    A per-channel activation calibration (PerChannelAbsmaxObserver /
+    FakeQuanterChannelWiseAbsMax) is PRESERVED: the scale stays a
+    vector broadcast along the observer's channel_axis at quant time.
+    A vector scale arriving WITHOUT a channel axis cannot be placed —
+    it collapses to the conservative per-tensor max, with a warning
+    (silent coarsening was ADVICE r5 #6)."""
+
+    def __init__(self, weight, axis, act_scale, act_channel_axis=None):
         super().__init__()
         qw, ws = quantize_absmax(weight, axis=axis)
         self.register_buffer("qweight", Tensor._wrap(qw))
         self.register_buffer("wscale",
                              Tensor._wrap(jnp.asarray(ws, jnp.float32)))
-        self.act_scale = None if act_scale is None else float(
-            np.max(np.asarray(act_scale)))
+        self.act_channel_axis = act_channel_axis
+        self._act_scalar = None
+        self._act_per_channel = False
+        if act_scale is None:
+            return
+        arr = np.asarray(act_scale, np.float32)
+        if arr.ndim == 0 or arr.size == 1:
+            self._act_scalar = float(arr.reshape(()))
+        elif act_channel_axis is None:
+            import warnings
+
+            warnings.warn(
+                f"per-channel activation scale (shape {arr.shape}) "
+                "converted without a channel_axis — collapsing to the "
+                "per-tensor max (coarser than calibrated); pass the "
+                "observer's channel_axis to keep the vector scale")
+            self._act_scalar = float(arr.max())
+        else:
+            # the buffer is the ONE source of truth for the vector
+            # grid (state_dict round-trips it; act_scale reads it)
+            self.register_buffer("ascale",
+                                 Tensor._wrap(jnp.asarray(arr)))
+            self._act_per_channel = True
+
+    @property
+    def act_scale(self):
+        """Calibrated activation grid: None (uncalibrated), a float
+        (per-tensor), or the per-channel vector read from the `ascale`
+        buffer (so a loaded state_dict is reflected here too)."""
+        if self._act_per_channel:
+            return np.asarray(self.ascale._array)
+        return self._act_scalar
 
     def _quant_act(self, x):
         """Round x to the observed int8 activation grid (no-op without
-        a calibrated scale)."""
-        if self.act_scale is None:
-            return x
+        a calibrated scale; per-channel grid when the observer was
+        per-channel)."""
         qmax = 127
-        s = self.act_scale
+        if self._act_per_channel:
+            axis = self.act_channel_axis
+
+            def aq_vec(a, s):
+                shape = [1] * a.ndim
+                shape[axis] = -1
+                sv = s.reshape(shape)
+                return jnp.clip(jnp.round(a / sv), -qmax - 1, qmax) * sv
+
+            return apply("quant_act_perchannel", aq_vec, x, self.ascale)
+        if self._act_scalar is None:
+            return x
+        s = self._act_scalar
 
         def aq(a):
             return jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
@@ -294,8 +342,9 @@ class _QuantedBase(nn.Layer):
 class QuantedLinear(_QuantedBase):
     """Inference-time converted Linear: dequant at the matmul edge."""
 
-    def __init__(self, linear, act_scale=None):
-        super().__init__(linear.weight, axis=1, act_scale=act_scale)
+    def __init__(self, linear, act_scale=None, act_channel_axis=None):
+        super().__init__(linear.weight, axis=1, act_scale=act_scale,
+                         act_channel_axis=act_channel_axis)
         self.bias = linear.bias
         self.weight_shape = list(linear.weight.shape)
 
@@ -310,8 +359,9 @@ class QuantedConv2D(_QuantedBase):
     """Inference-time converted Conv2D: per-output-channel int8 weight,
     dequant at the conv edge (reference nn/quant/quantized_conv.py)."""
 
-    def __init__(self, conv, act_scale=None):
-        super().__init__(conv.weight, axis=0, act_scale=act_scale)
+    def __init__(self, conv, act_scale=None, act_channel_axis=None):
+        super().__init__(conv.weight, axis=0, act_scale=act_scale,
+                         act_channel_axis=act_channel_axis)
         self.bias = conv.bias
         self._stride = conv._stride
         self._padding = conv._padding
@@ -359,11 +409,18 @@ class QAT:
 
     def convert(self, model, inplace=True):
         def factory(l):
-            act = l.a_quanter.scale() if l.a_quanter is not None and \
-                getattr(l.a_quanter, "_absmax", None) is not None else None
+            q = l.a_quanter
+            act = q.scale() if q is not None and \
+                getattr(q, "_absmax", None) is not None else None
+            # a per-channel activation quanter's axis rides along so
+            # the vector calibration survives conversion
+            ax = getattr(q, "channel_axis", None) if q is not None \
+                else None
             if isinstance(l, QATConv2D):
-                return QuantedConv2D(l.inner, act_scale=act)
-            return QuantedLinear(l.inner, act_scale=act)
+                return QuantedConv2D(l.inner, act_scale=act,
+                                     act_channel_axis=ax)
+            return QuantedLinear(l.inner, act_scale=act,
+                                 act_channel_axis=ax)
 
         return _replace_layers(
             model, lambda l: isinstance(l, (QATLinear, QATConv2D)),
@@ -398,9 +455,13 @@ class PTQ:
     def convert(self, model, inplace=True):
         def factory(l):
             act = l.observer.scale() if l.observer else None
+            ax = getattr(l.observer, "channel_axis", None) \
+                if l.observer else None
             if isinstance(l.inner, nn.Conv2D):
-                return QuantedConv2D(l.inner, act_scale=act)
-            return QuantedLinear(l.inner, act_scale=act)
+                return QuantedConv2D(l.inner, act_scale=act,
+                                     act_channel_axis=ax)
+            return QuantedLinear(l.inner, act_scale=act,
+                                 act_channel_axis=ax)
 
         return _replace_layers(
             model, lambda l: isinstance(l, PTQ._Observed), factory)

@@ -110,3 +110,55 @@ def test_pyproject_packaging_metadata():
 
     pkgs = find_packages(where=REPO, include=["paddle_tpu*"])
     assert "paddle_tpu" in pkgs and "paddle_tpu.distributed" in pkgs
+
+
+def test_gate_floor_row_absolute_pass_condition(tmp_path):
+    """VERDICT r5 next #8a: a row with a decided 'floor' is gated on
+    clearing that absolute throughput, not on the relative drop vs its
+    own best-ever value (the ResNet go/no-go shape)."""
+    base = {"r": {"metric": "r", "value": 2435.0, "unit": "images/s",
+                  "floor": 2350.0}}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    # 2360 is a >3% drop vs 2435 BUT clears the floor: pass
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "r", "value": 2360.0, "unit": "images/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json"),
+                "--threshold", "0.02"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    # below the floor fails regardless of threshold
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "r", "value": 2300.0, "unit": "images/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json"),
+                "--threshold", "0.50"])
+    assert res.returncode == 1
+    assert "below the decided floor" in res.stdout
+
+
+def test_gate_update_preserves_floor(tmp_path):
+    base = {"r": {"metric": "r", "value": 2435.0, "floor": 2350.0}}
+    (tmp_path / "base.json").write_text(json.dumps(base))
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "r", "value": 2500.0, "unit": "images/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json"), "--update"])
+    assert res.returncode == 0
+    data = json.loads((tmp_path / "base.json").read_text())
+    assert data["r"]["value"] == 2500.0 and data["r"]["floor"] == 2350.0
+    # a partial run MISSING the floored row must not erase the decision
+    _bench_lines(tmp_path / "cur.jsonl",
+                 [{"metric": "other", "value": 1.0, "unit": "x/s"}])
+    res = _run(["--bench", str(tmp_path / "cur.jsonl"),
+                "--baseline", str(tmp_path / "base.json"), "--update"])
+    assert res.returncode == 0
+    data = json.loads((tmp_path / "base.json").read_text())
+    assert data["r"]["floor"] == 2350.0 and data["r"]["value"] == 2500.0
+    assert data["other"]["value"] == 1.0
+
+
+def test_repo_resnet_row_carries_decided_floor():
+    """The committed baseline encodes the ResNet go/no-go decision."""
+    with open(os.path.join(REPO, "BENCH_BASELINE.json")) as f:
+        base = json.load(f)
+    assert base["resnet50_train_images_per_sec_per_chip"]["floor"] == 2350.0

@@ -237,3 +237,73 @@ def test_zero_sample_push_participates_without_weight():
         np.testing.assert_allclose(coord.global_state["w"], [7.0])
     finally:
         coord.close()
+
+
+def test_malformed_push_errors_client_not_round():
+    """ADVICE r5 #4: a push whose keys/shapes don't match global_state
+    errors AT PUSH TIME on the offending client; the round stays
+    foldable for everyone else (no wedged poll loops)."""
+    coord = Coordinator({"w": np.zeros(2), "b": np.zeros(1)},
+                        selector=ClientSelector(max_rounds=1))
+    try:
+        good = FLClient(coord.endpoint, "good")
+        bad = FLClient(coord.endpoint, "bad")
+        with pytest.raises(ValueError, match="missing keys"):
+            bad.push(0, {"w": np.ones(2)}, 5)            # 'b' absent
+        with pytest.raises(ValueError, match="unknown keys"):
+            bad.push(0, {"w": np.ones(2), "b": np.zeros(1),
+                         "extra": np.ones(3)}, 5)
+        with pytest.raises(ValueError, match="shape"):
+            bad.push(0, {"w": np.ones(3), "b": np.zeros(1)}, 5)
+        assert coord.round_idx == 0                      # nothing stored
+        # the round folds normally once both clients push well-formed
+        good.push(0, {"w": np.array([2.0, 4.0]), "b": np.ones(1)}, 10)
+        bad.push(0, {"w": np.array([4.0, 8.0]), "b": np.ones(1)}, 10)
+        assert coord.wait_rounds(1) == 1
+        np.testing.assert_allclose(coord.global_state["w"], [3.0, 6.0])
+    finally:
+        coord.close()
+
+
+def test_selector_wait_midround_then_join_next_round():
+    """VERDICT r5 next #6: a selector WAITs a low-bandwidth client for
+    round 0 (cohort gate + stray-push guard hold under selector-driven
+    partitioning), then the waited client JOINs round 1 and its update
+    enters that round's average."""
+
+    class BandwidthGate(ClientSelectorBase):
+        def select(self, clients_info, round_idx):
+            if round_idx >= 2:
+                return {c: FLStrategy.FINISH for c in clients_info}
+            if round_idx == 0:
+                return {c: (FLStrategy.JOIN
+                            if info.get(ClientInfoAttr.BANDWIDTH, 0)
+                            >= 100 else FLStrategy.WAIT)
+                        for c, info in clients_info.items()}
+            return {c: FLStrategy.JOIN for c in clients_info}
+
+    coord = Coordinator({"w": np.zeros(1)}, selector=BandwidthGate(),
+                        min_clients=2)
+    try:
+        fast = FLClient(coord.endpoint, "fast",
+                        info={ClientInfoAttr.BANDWIDTH: 1000})
+        slow = FLClient(coord.endpoint, "slow",
+                        info={ClientInfoAttr.BANDWIDTH: 3})
+        assert fast.poll_round() == (FLStrategy.JOIN, 0)
+        assert slow.poll_round() == (FLStrategy.WAIT, 0)
+        # stray push from the WAITed client mid-round 0: neither folds
+        # the round early nor enters the average
+        slow.push(0, {"w": np.array([500.0])}, 50)
+        assert coord.round_idx == 0
+        fast.push(0, {"w": np.array([8.0])}, 10)
+        assert coord.wait_rounds(1) == 1
+        np.testing.assert_allclose(coord.global_state["w"], [8.0])
+        # round 1: the waited client JOINs and participates
+        assert slow.poll_round() == (FLStrategy.JOIN, 1)
+        fast.push(1, {"w": np.array([6.0])}, 10)
+        slow.push(1, {"w": np.array([12.0])}, 30)
+        assert coord.wait_rounds(2) == 2
+        np.testing.assert_allclose(coord.global_state["w"], [10.5])
+        assert slow.poll_round()[0] == FLStrategy.FINISH
+    finally:
+        coord.close()

@@ -152,3 +152,42 @@ def test_ptq_conv_pipeline():
     assert "QuantedConv2D" in kinds and "QuantedLinear" in kinds
     out = converted(_x(seed=9)).numpy()
     assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.08
+
+
+def test_perchannel_activation_scale_survives_convert():
+    """ADVICE r5 #6: a PerChannelAbsmaxObserver calibration converts to
+    a VECTOR activation scale broadcast along the observer's
+    channel_axis — not silently collapsed to one scalar."""
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 6))
+    ptq = Q.PTQ(Q.QuantConfig(
+        activation=Q.PerChannelAbsmaxObserver(channel_axis=1)))
+    observed = ptq.quantize(net)
+    # channels with very different ranges: per-channel grids differ
+    x = np.ones((8, 4), np.float32)
+    x[:, 0] *= 100.0
+    x[:, 1] *= 0.01
+    observed(paddle.to_tensor(x))
+    converted = ptq.convert(observed)
+    ql = converted[0]
+    assert isinstance(ql, Q.QuantedLinear)
+    assert np.ndim(ql.act_scale) == 1 and ql.act_scale.shape == (4,)
+    assert ql.act_channel_axis == 1
+    # the big channel keeps fidelity a shared scalar grid would lose:
+    # channel 1 values (0.01) round to 0 on a 100-max absmax grid
+    y = ql._quant_act(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(y[:, 1], 0.01, rtol=0.05)
+    np.testing.assert_allclose(y[:, 0], 100.0, rtol=0.05)
+
+
+def test_vector_scale_without_axis_warns_and_collapses():
+    """A vector scale with no channel axis can't be placed — loud
+    conservative collapse, not silent."""
+    paddle.seed(4)
+    lin = nn.Linear(4, 3)
+    with pytest.warns(UserWarning, match="channel_axis"):
+        ql = Q.QuantedLinear(lin, act_scale=np.array([1.0, 2.0, 4.0,
+                                                      8.0]))
+    assert ql.act_scale == 8.0                 # per-tensor max
+    out = ql(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert tuple(out.shape) == (2, 3)

@@ -195,3 +195,53 @@ def test_elastic_rejoin_scale_out(tmp_path):
         assert "world=3" in line and "resumed_from=8" in line, line
     with open(ckpt) as f:
         assert json.load(f) == {"step": 10, "world": 3}
+
+
+def test_elastic_resize_consumes_only_absorbed_joiners():
+    """ADVICE r5 #5: when the elastic_max clamp (or an unchanged world
+    size) absorbs only some external joiners, the rest keep their TTL
+    leases — their agents stay registered and they rejoin at a LATER
+    restart boundary instead of silently retiring."""
+    import argparse
+
+    from paddle_tpu.distributed.launch.elastic import ElasticMaster
+    from paddle_tpu.distributed.launch.main import _elastic_resize
+
+    def _args(nprocs, emin, emax):
+        return argparse.Namespace(nprocs=nprocs, nnodes=1,
+                                  nprocs_per_node=None,
+                                  elastic_min=emin, elastic_max=emax)
+
+    m = ElasticMaster()
+    try:
+        # 2 launcher-owned survivors + 3 external joiners, ceiling 4:
+        # only TWO joiners fit the new world (4 - 2 survivors)
+        m.register("rank0")
+        m.register("rank1")
+        for j in ("joinA", "joinB", "joinC"):
+            m.register(j, ttl=60)                     # TTL = external
+        args = _args(nprocs=2, emin=2, emax=4)
+        _elastic_resize(args, m)
+        assert args.nprocs == 4                       # scaled out to max
+        joiners_left = sorted(j for j, info in m.live().items()
+                              if info.get("_external"))
+        assert joiners_left == ["joinC"]              # lease intact
+
+        # a later boundary with headroom absorbs the leftover joiner
+        args2 = _args(nprocs=4, emin=2, emax=8)
+        _elastic_resize(args2, m)
+        assert args2.nprocs == 3                      # 2 owned + joinC
+        assert not [j for j, info in m.live().items()
+                    if info.get("_external")]
+
+        # new == current with a joiner replacing lost capacity: the
+        # joiner IS absorbed (its capacity relaunches as a local rank)
+        m.clear_owned()
+        m.register("rank0")                            # 1 survivor
+        m.register("late", ttl=60)                     # external joiner
+        args3 = _args(nprocs=2, emin=1, emax=2)
+        _elastic_resize(args3, m)
+        assert args3.nprocs == 2                       # unchanged size
+        assert "late" not in m.live()                  # but absorbed
+    finally:
+        m.close()

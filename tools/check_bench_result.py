@@ -54,6 +54,17 @@ def check_models(current, baseline, threshold):
             failures.append(f"{metric}: current run FAILED")
             continue
         bv, cv = float(base["value"]), float(cur["value"])
+        if "floor" in base:
+            # absolute pass condition replacing the relative check: the
+            # row is gated on clearing a decided throughput floor (the
+            # ResNet go/no-go call — see DESIGN_DECISIONS.md), not on
+            # chasing its own best-ever value
+            fv = float(base["floor"])
+            if cv < fv:
+                failures.append(
+                    f"{metric}: {cv:.1f} below the decided floor "
+                    f"{fv:.1f} {base.get('unit', '')}".rstrip())
+            continue
         if bv <= 0:
             continue
         drop = 1.0 - cv / bv
@@ -100,6 +111,24 @@ def main(argv=None):
         current = load_bench_lines(args.bench)
         threshold = 0.10 if args.threshold is None else args.threshold
         if args.update:
+            # decided floors are part of the GATE, not of any one run:
+            # carry them over so a refresh can't silently drop them —
+            # including a floored row the current run didn't emit at
+            # all (a partial run must not erase a go/no-go decision)
+            try:
+                with open(args.baseline) as f:
+                    old = json.load(f)
+            except (FileNotFoundError, json.JSONDecodeError):
+                old = {}
+            for k, rec in old.items():
+                if "floor" not in rec:
+                    continue
+                if k in current:
+                    current[k]["floor"] = rec["floor"]
+                else:
+                    current[k] = rec
+                    print(f"note: {k}: not in current run; floored "
+                          "baseline row kept as-is")
             with open(args.baseline, "w") as f:
                 json.dump(current, f, indent=1)
             print(f"baseline updated: {args.baseline} "

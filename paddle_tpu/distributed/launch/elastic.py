@@ -34,7 +34,9 @@ from __future__ import annotations
 # human (or the `launch.elastic live` CLI) can poke the registry with
 # netcat when debugging a wedged pod; pickle would also let a rogue
 # host on the rendezvous port execute code in the launcher.
+import hmac
 import json
+import os
 import socket
 import socketserver
 import threading
@@ -43,6 +45,16 @@ import time
 __all__ = ["ElasticMaster", "ElasticClient", "ElasticAgent"]
 
 _DEFAULT_TTL = 6.0
+
+# wire commands that mutate membership/KV state: with a job token set,
+# these require it. Reads (live/get) stay open — they are the debugging
+# surface ("poke with netcat") and leak only what the launcher already
+# prints. heartbeat IS authed: a rogue peer replaying heartbeats could
+# otherwise keep a dead joiner's lease alive forever, and the next
+# elastic resize would absorb the phantom into the new world size
+# (ElasticClient attaches the token to every call, so no legitimate
+# caller changes).
+_AUTHED_CMDS = ("register", "heartbeat", "leave", "put")
 
 
 def _send(sock, obj):
@@ -66,6 +78,19 @@ class _Handler(socketserver.StreamRequestHandler):
         cmd = req.get("cmd")
         member = req.get("member")
         now = time.monotonic()
+        if master.token is not None and cmd in _AUTHED_CMDS \
+                and not hmac.compare_digest(
+                    str(req.get("token") or "").encode(
+                        "utf-8", "surrogatepass"),
+                    master.token.encode("utf-8", "surrogatepass")):
+            # reject before taking the lock or touching state: a rogue
+            # host on the rendezvous port must not be able to register
+            # phantom members (inflating the next elastic resize),
+            # evict live ones, or poison the KV space
+            _send(self.connection,
+                  {"ok": False, "error": f"unauthorized {cmd!r}: "
+                   "missing/invalid job token"})
+            return
         with master._lock:
             if cmd == "register":
                 ttl = req.get("ttl")
@@ -116,9 +141,17 @@ class _Server(socketserver.ThreadingTCPServer):
 
 
 class ElasticMaster:
-    """In-launcher KV membership registry (etcd + ETCDMaster analog)."""
+    """In-launcher KV membership registry (etcd + ETCDMaster analog).
 
-    def __init__(self, host="127.0.0.1", port=0):
+    `token`: per-job shared secret (ADVICE r5). When set, wire-level
+    register/leave/put must present it (the launcher generates one and
+    hands it to ranks via PADDLE_ELASTIC_TOKEN; `launch.elastic join`
+    reads the same env or --token). None or empty = open registry
+    (tests, ad-hoc debugging) — an empty string must not LOOK
+    authenticated while accepting every tokenless client."""
+
+    def __init__(self, host="127.0.0.1", port=0, token=None):
+        self.token = token or None
         self._members: dict = {}
         self._kv: dict = {}
         self._lock = threading.Lock()
@@ -177,12 +210,19 @@ class ElasticClient:
     """TCP client for a remote ElasticMaster (external members and
     node-rank launchers use this; the owning launcher talks directly)."""
 
-    def __init__(self, endpoint: str, timeout: float = 10.0):
+    def __init__(self, endpoint: str, timeout: float = 10.0,
+                 token=None):
         ip, port = endpoint.rsplit(":", 1)
         self._addr = (ip, int(port))
         self._timeout = timeout
+        # default to the launcher-provided job token so in-job callers
+        # (workers, rejoin agents) authenticate without plumbing
+        self._token = token if token is not None \
+            else os.environ.get("PADDLE_ELASTIC_TOKEN")
 
     def _call(self, check=True, **req):
+        if self._token is not None:
+            req.setdefault("token", self._token)
         with socket.create_connection(self._addr,
                                       timeout=self._timeout) as s:
             _send(s, req)
@@ -223,8 +263,9 @@ class ElasticAgent:
     launchers to report node liveness to node 0's master."""
 
     def __init__(self, endpoint: str, member: str, info=None,
-                 ttl: float = _DEFAULT_TTL, interval: float | None = None):
-        self.client = ElasticClient(endpoint)
+                 ttl: float = _DEFAULT_TTL, interval: float | None = None,
+                 token=None):
+        self.client = ElasticClient(endpoint, token=token)
         self.member = member
         self.ttl = ttl
         self.interval = interval if interval is not None else ttl / 3.0
@@ -268,12 +309,18 @@ def main(argv=None):
     p.add_argument("--ttl", type=float, default=_DEFAULT_TTL)
     p.add_argument("--hold", type=float, default=0,
                    help="seconds to keep heartbeating (0 = forever)")
+    p.add_argument("--token", default=None,
+                   help="per-job registry token (default: "
+                        "$PADDLE_ELASTIC_TOKEN; required to join a "
+                        "launcher-started registry)")
     args = p.parse_args(argv)
     if args.action == "live":
-        print(json.dumps(ElasticClient(args.master).live()))
+        print(json.dumps(
+            ElasticClient(args.master, token=args.token).live()))
         return 0
     member = args.member or f"joiner-{socket.gethostname()}"
-    agent = ElasticAgent(args.master, member, ttl=args.ttl)
+    agent = ElasticAgent(args.master, member, ttl=args.ttl,
+                         token=args.token)
     print(f"joined as {member}", flush=True)
     try:
         if args.hold:

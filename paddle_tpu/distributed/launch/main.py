@@ -112,7 +112,7 @@ def _world_size(args) -> int:
 
 def _rank_env(args, rank: int, master: str, server_rank=None,
               node_rank=None, rpc_master=None,
-              elastic_endpoint=None) -> dict:
+              elastic_endpoint=None, elastic_token=None) -> dict:
     from paddle_tpu.distributed.spawn import rank_env_overrides
 
     env = dict(os.environ)
@@ -129,6 +129,8 @@ def _rank_env(args, rank: int, master: str, server_rank=None,
         # lets a recovered host's agent (or a test worker standing in
         # for one) find the membership registry
         env["PADDLE_ELASTIC_MASTER"] = elastic_endpoint
+        if elastic_token:
+            env["PADDLE_ELASTIC_TOKEN"] = elastic_token
     if args.nprocs_per_node and server_rank is None:
         # node topology env (reference: PADDLE_TRAINERS_NUM plus the
         # node/local split the multi-node launcher derives rank from)
@@ -177,13 +179,26 @@ def launch(argv=None) -> int:
     # rejoins the node-0 pod via `launch.elastic join`).
     emaster = None
     if args.max_restarts > 0 and args.node_rank in (None, 0):
+        import secrets
+
         from .elastic import ElasticMaster
 
+        # per-job token (ADVICE r5): wire-level register/leave/put on
+        # the rendezvous port require it; ranks/joiners get it via
+        # PADDLE_ELASTIC_TOKEN (printed once for operators running
+        # `launch.elastic join` from a recovered host)
+        token = secrets.token_hex(16)
         if args.elastic_master:
             eip, eport = args.elastic_master.rsplit(":", 1)
-            emaster = ElasticMaster(eip, int(eport))
+            emaster = ElasticMaster(eip, int(eport), token=token)
         else:
-            emaster = ElasticMaster()
+            emaster = ElasticMaster(token=token)
+        # printed for BOTH branches: an operator running
+        # `launch.elastic join` from a recovered host needs endpoint +
+        # token regardless of whether the port was auto-picked
+        sys.stderr.write(
+            f"[launch] elastic registry on {emaster.endpoint} "
+            f"(join token: {token})\n")
         # the scale-out ceiling is fixed at job start (reference --np
         # MIN:MAX), independent of later scale-ins
         if not args.elastic_max:
@@ -331,7 +346,9 @@ def _launch_once(args, master: str, probes, attempt: int = 0,
                             else None,
                             node_rank=node, rpc_master=rpc_master,
                             elastic_endpoint=(emaster.endpoint
-                                              if emaster else None))
+                                              if emaster else None),
+                            elastic_token=(emaster.token
+                                           if emaster else None))
             if probes:
                 # release the probed ports at the last moment (rank 0's
                 # binds happen moments later; a same-port steal now

@@ -27,6 +27,24 @@ def init_parallel_env(backend: str = "xla") -> None:
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if nprocs > 1 and coord:
+        if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+            # CPU multiprocess collectives need an explicit transport
+            # (the test/CI backend has no ICI): route them over gloo.
+            # Env-sniffed, NOT jax.default_backend() — that would
+            # initialize the backend before distributed.initialize,
+            # which multiprocess CPU forbids.
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:
+                # don't swallow silently: without gloo the collectives
+                # below fail with an opaque backend error
+                import warnings
+
+                warnings.warn(
+                    "could not enable gloo CPU collectives "
+                    f"({e}); multiprocess CPU collectives may fail",
+                    RuntimeWarning)
         port = os.environ.get("MASTER_PORT", "8476")
         jax.distributed.initialize(
             coordinator_address=f"{coord.split(':')[0]}:{port}",

@@ -28,6 +28,12 @@ def check_enabled():
 
 
 def _report(bad_names, where):
+    from paddle_tpu.observability.metrics import get_registry
+
+    get_registry().counter(
+        "nan_inf_events_total",
+        "NaN/Inf detections (FLAGS_check_nan_inf); each event may "
+        "cover several tensors of one op/step.").inc()
     msg = (f"nan/inf detected in {where}: {', '.join(bad_names)} "
            "(FLAGS_check_nan_inf)")
     if flag("FLAGS_check_nan_inf_level") >= 3:

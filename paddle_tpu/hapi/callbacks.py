@@ -11,7 +11,7 @@ import os
 import time
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler"]
+           "LRScheduler", "MetricsLogger"]
 
 
 class Callback:
@@ -142,6 +142,74 @@ class EarlyStopping(Callback):
             if self.wait > self.patience:
                 self.model.stop_training = True
                 self.stopped_epoch = True
+
+
+class MetricsLogger(Callback):
+    """Forward hapi train/eval logs into an observability registry.
+
+        model.fit(..., callbacks=[MetricsLogger()])
+
+    Per train batch: step counter + per-key gauges labeled
+    phase="train"; per eval end: gauges labeled phase="eval"; per
+    epoch: epoch counter. Numeric log values only (hapi metrics may
+    return lists — the first element is taken, matching ProgBarLogger's
+    display convention)."""
+
+    def __init__(self, registry=None, prefix="hapi"):
+        super().__init__()
+        if registry is None:
+            from paddle_tpu.observability.metrics import get_registry
+
+            registry = get_registry()
+        self.registry = registry
+        self.prefix = prefix
+        self._steps = registry.counter(
+            f"{prefix}_steps_total", "hapi train batches completed.")
+        self._epochs = registry.counter(
+            f"{prefix}_epochs_total", "hapi epochs completed.")
+        self._gauges = {}              # per-key handle cache (hot path)
+        self._names = {}               # sanitized name -> original key
+
+    def _gauge(self, key):
+        g = self._gauges.get(key)
+        if g is None:
+            import re
+
+            name = re.sub(r"[^a-zA-Z0-9_:]", "_",
+                          f"{self.prefix}_{key}")
+            prior = self._names.setdefault(name, key)
+            if prior != key:
+                # two distinct log keys sanitizing to one metric would
+                # silently interleave their values — be loud instead
+                raise ValueError(
+                    f"hapi metric names {prior!r} and {key!r} both "
+                    f"sanitize to {name!r}; rename one")
+            g = self._gauges[key] = self.registry.gauge(
+                name, f"hapi log value {key!r}.", labelnames=("phase",))
+        return g
+
+    def _forward(self, logs, phase):
+        import numbers
+
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            # numbers.Real, not (int, float): metric accumulators often
+            # hand back numpy scalars (np.float32 is not a float)
+            if isinstance(v, bool) or not isinstance(v, numbers.Real):
+                continue
+            self._gauge(k).labels(phase=phase).set(float(v))
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps.inc()
+        self._forward(logs, "train")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epochs.inc()
+        self._forward(logs, "train")
+
+    def on_eval_end(self, logs=None):
+        self._forward(logs, "eval")
 
 
 class LRScheduler(Callback):

@@ -32,10 +32,20 @@ recompiles:
 
 Greedy decoding matches `GPTForCausalLM.generate(use_cache=True)`
 token-for-token per request (the parity contract CI enforces).
+
+Serving telemetry (PR 2): every engine carries a metrics registry
+(`engine.metrics`, observability tier) — TTFT/TPOT histograms, queue/
+slot/pool gauges with a high-water mark, admission/finish/stall
+counters, and a decode-recompile counter wired to the count_traces
+probes (steady-state contract: 0). Scheduler iterations and compiled
+prefill/decode dispatches also emit `engine.*` spans into the profiler
+recorder, so a chrome trace shows the scheduler timeline next to the
+metrics story.
 """
 from __future__ import annotations
 
 import math
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -47,6 +57,9 @@ import jax.numpy as jnp
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.jit.api import bound_state, count_traces, dedup_params, \
     model_buffers
+from paddle_tpu.observability.metrics import LATENCY_BUCKETS, \
+    MetricsRegistry
+from paddle_tpu.profiler import RecordEvent
 
 __all__ = ["PagedKVCache", "GenerationEngine", "Request"]
 
@@ -97,6 +110,7 @@ class Request:
     prompt: np.ndarray                 # int32 [plen]
     max_new_tokens: int
     eos_token_id: int = None
+    arrived_at: float = None           # perf_counter at add_request
 
 
 @dataclass
@@ -106,6 +120,7 @@ class _Slot:
     req: Request
     blocks: list                       # owned pool block ids, in order
     generated: list = field(default_factory=list)
+    last_token_at: float = None        # perf_counter of newest token
 
     @property
     def feed_pos(self):
@@ -131,7 +146,8 @@ class GenerationEngine:
 
     def __init__(self, model, num_slots=8, block_size=16,
                  num_blocks=None, prefill_buckets=None,
-                 max_model_len=None, eos_token_id=None, donate=None):
+                 max_model_len=None, eos_token_id=None, donate=None,
+                 registry=None):
         cfg = model.config
         if model.training and cfg.dropout > 0:
             raise ValueError("GenerationEngine decodes deterministically "
@@ -178,6 +194,80 @@ class GenerationEngine:
         self._results = {}
         self._auto_id = 0
         self.tokens_generated = 0
+        # serving telemetry: per-engine registry by default so counter
+        # exactness survives multiple engines in one process; pass
+        # observability.get_registry() to publish on the process default
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._init_metrics()
+
+    def _init_metrics(self):
+        m = self.metrics
+        self._m_ttft = m.histogram(
+            "engine_ttft_seconds",
+            "Request arrival to first generated token (includes queue "
+            "wait and prefill).", buckets=LATENCY_BUCKETS)
+        self._m_tpot = m.histogram(
+            "engine_tpot_seconds",
+            "Per-output-token latency: time since the slot's PREVIOUS "
+            "token, so block-stall waits show up (not just the "
+            "producing iteration's wall time).",
+            buckets=LATENCY_BUCKETS)
+        self._m_queue = m.gauge(
+            "engine_queue_depth", "Requests waiting for a slot.")
+        self._m_active = m.gauge(
+            "engine_active_slots", "Decode lanes currently occupied.")
+        self._m_admissions = m.counter(
+            "engine_admissions_total", "Requests admitted into a lane.")
+        self._m_finished = m.counter(
+            "engine_finished_total",
+            "Requests finished (lane vacated).", labelnames=("reason",))
+        self._m_stalls = m.counter(
+            "engine_block_stalls_total",
+            "Iterations a lane/admission skipped for want of a pool "
+            "block.", labelnames=("path",))
+        self._m_tokens = m.counter(
+            "engine_tokens_generated_total", "New tokens emitted.")
+        self._m_pool_used = m.gauge(
+            "engine_pool_used_blocks", "KV pool blocks in use.")
+        self._m_pool_util = m.gauge(
+            "engine_pool_utilization",
+            "Used fraction of allocatable KV pool blocks.")
+        self._m_pool_hw = m.gauge(
+            "engine_pool_used_high_water_blocks",
+            "High-water mark of KV pool blocks in use.")
+        self._m_decode_traces = m.gauge(
+            "engine_decode_traces",
+            "Times the decode step traced (steady-state contract: 1).")
+        self._m_prefill_traces = m.gauge(
+            "engine_prefill_traces",
+            "Times prefill traced (bounded by len(prefill_buckets)).")
+        self._m_recompiles = m.counter(
+            "engine_decode_recompiles_total",
+            "Decode retraces past the first compile — nonzero means a "
+            "shape-stability bug.")
+        self._decode_traces_seen = 0
+
+    def _update_pool_gauges(self):
+        used = self.cache.num_blocks - 1 - self.cache.num_free
+        self._m_pool_used.set(used)
+        self._m_pool_util.set(used / max(self.cache.num_blocks - 1, 1))
+        self._m_pool_hw.set_max(used)
+
+    def _sample_traces(self):
+        """Mirror the count_traces probes into metrics; a decode trace
+        beyond the first is a recompile (the ==0 steady-state SLO)."""
+        t = self._decode_pure.traces
+        if t > self._decode_traces_seen:
+            if self._decode_traces_seen >= 1:
+                self._m_recompiles.inc(t - self._decode_traces_seen)
+            self._decode_traces_seen = t
+        self._m_decode_traces.set(t)
+        self._m_prefill_traces.set(self._prefill_pure.traces)
+
+    def metrics_snapshot(self):
+        """JSON-able snapshot of this engine's serving metrics."""
+        return self.metrics.snapshot()
 
     # -- compiled steps ----------------------------------------------------
     def _default_buckets(self):
@@ -271,7 +361,8 @@ class GenerationEngine:
                              "decoding, or awaiting collection")
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         self._queue.append(Request(req_id, prompt, int(max_new_tokens),
-                                   eos))
+                                   eos, arrived_at=time.perf_counter()))
+        self._m_queue.set(len(self._queue))
         return req_id
 
     # -- scheduler ---------------------------------------------------------
@@ -293,11 +384,12 @@ class GenerationEngine:
         ids.update(self._results)
         return ids
 
-    def _finish(self, slot):
+    def _finish(self, slot, reason):
         req = slot.req
         self._results[req.req_id] = \
             list(map(int, req.prompt)) + slot.generated
         self.cache.free(slot.blocks)
+        self._m_finished.labels(reason=reason).inc()
 
     def _admit(self):
         """Fill free lanes from the queue (FIFO): allocate the prompt's
@@ -310,72 +402,110 @@ class GenerationEngine:
             need = math.ceil(plen / self.block_size)
             blocks = self.cache.allocate(need)
             if blocks is None:
+                self._m_stalls.labels(path="admit").inc()
                 break                      # pool pressure: retry later
+            self._update_pool_gauges()     # high-water sees the peak
             self._queue.popleft()
             bucket = self._bucket_for(plen)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :plen] = req.prompt
             row = np.zeros(self.max_blocks, np.int32)
             row[:need] = blocks
-            first, self.cache.kpool, self.cache.vpool = self._prefill(
-                self._state_arrays(), self.cache.kpool, self.cache.vpool,
-                jnp.asarray(tokens), jnp.int32(plen), jnp.asarray(row))
-            slot = _Slot(req=req, blocks=blocks,
-                         generated=[int(first)])
+            with RecordEvent("engine.prefill"):
+                first, self.cache.kpool, self.cache.vpool = \
+                    self._prefill(
+                        self._state_arrays(), self.cache.kpool,
+                        self.cache.vpool, jnp.asarray(tokens),
+                        jnp.int32(plen), jnp.asarray(row))
+                first = int(first)         # sync: first token is out
+            slot = _Slot(req=req, blocks=blocks, generated=[first],
+                         last_token_at=time.perf_counter())
             self.tokens_generated += 1
+            self._m_tokens.inc()
+            self._m_admissions.inc()
+            if req.arrived_at is not None:
+                self._m_ttft.observe(time.perf_counter() -
+                                     req.arrived_at)
             admitted += 1
             if (req.eos_token_id is not None
-                    and slot.generated[-1] == req.eos_token_id) \
-                    or req.max_new_tokens == 1:
-                self._finish(slot)         # one-token request / instant EOS
+                    and slot.generated[-1] == req.eos_token_id):
+                self._finish(slot, "eos")  # instant EOS
+                continue
+            if req.max_new_tokens == 1:
+                self._finish(slot, "length")   # one-token request
                 continue
             self._slots[self._slots.index(None)] = slot
+        self._m_queue.set(len(self._queue))
         return admitted
 
     def step(self):
         """One scheduler iteration: admit, then one batched decode step
         over every lane that holds a block for its write position.
         Returns the number of lanes+admissions that made progress."""
-        progressed = self._admit()
-        runnable = []
-        for i, slot in enumerate(self._slots):
-            if slot is None:
-                continue
-            # on-demand growth: the feed position may open a new block
-            bi = slot.feed_pos // self.block_size
-            if bi >= len(slot.blocks):
-                got = self.cache.allocate(1)
-                if got is None:
-                    continue               # stalled this iteration
-                slot.blocks.extend(got)
-            runnable.append(i)
-        if not runnable:
-            return progressed
-        tokens = np.zeros((self.num_slots, 1), np.int32)
-        positions = np.zeros(self.num_slots, np.int32)
-        tables = np.zeros((self.num_slots, self.max_blocks), np.int32)
-        for i in runnable:
-            slot = self._slots[i]
-            tokens[i, 0] = slot.generated[-1]
-            positions[i] = slot.feed_pos
-            tables[i, :len(slot.blocks)] = slot.blocks
-        nxt, self.cache.kpool, self.cache.vpool = self._decode(
-            self._state_arrays(), self.cache.kpool, self.cache.vpool,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(tables))
-        nxt = np.asarray(nxt)
-        for i in runnable:
-            slot = self._slots[i]
-            tok = int(nxt[i])
-            slot.generated.append(tok)
-            self.tokens_generated += 1
-            req = slot.req
-            if (req.eos_token_id is not None
-                    and tok == req.eos_token_id) \
-                    or len(slot.generated) >= req.max_new_tokens:
-                self._finish(slot)
-                self._slots[i] = None
-        return progressed + len(runnable)
+        with RecordEvent("engine.step"):
+            progressed = self._admit()
+            runnable = []
+            for i, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                # on-demand growth: the feed position may open a new
+                # block
+                bi = slot.feed_pos // self.block_size
+                if bi >= len(slot.blocks):
+                    got = self.cache.allocate(1)
+                    if got is None:
+                        self._m_stalls.labels(path="decode").inc()
+                        continue           # stalled this iteration
+                    slot.blocks.extend(got)
+                    self._update_pool_gauges()
+                runnable.append(i)
+            if not runnable:
+                self._end_of_step_gauges()
+                return progressed
+            tokens = np.zeros((self.num_slots, 1), np.int32)
+            positions = np.zeros(self.num_slots, np.int32)
+            tables = np.zeros((self.num_slots, self.max_blocks),
+                              np.int32)
+            for i in runnable:
+                slot = self._slots[i]
+                tokens[i, 0] = slot.generated[-1]
+                positions[i] = slot.feed_pos
+                tables[i, :len(slot.blocks)] = slot.blocks
+            with RecordEvent("engine.decode"):
+                nxt, self.cache.kpool, self.cache.vpool = self._decode(
+                    self._state_arrays(), self.cache.kpool,
+                    self.cache.vpool, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(tables))
+                nxt = np.asarray(nxt)      # sync: tokens are out
+            now = time.perf_counter()
+            for i in runnable:
+                slot = self._slots[i]
+                tok = int(nxt[i])
+                slot.generated.append(tok)
+                self.tokens_generated += 1
+                self._m_tokens.inc()
+                # inter-token latency per SLOT, not this iteration's
+                # wall time: a lane that sat out N stalled iterations
+                # reports the (N+1)-iteration gap its user experienced
+                if slot.last_token_at is not None:
+                    self._m_tpot.observe(now - slot.last_token_at)
+                slot.last_token_at = now
+                req = slot.req
+                if req.eos_token_id is not None \
+                        and tok == req.eos_token_id:
+                    self._finish(slot, "eos")
+                    self._slots[i] = None
+                elif len(slot.generated) >= req.max_new_tokens:
+                    self._finish(slot, "length")
+                    self._slots[i] = None
+            self._end_of_step_gauges()
+            return progressed + len(runnable)
+
+    def _end_of_step_gauges(self):
+        self._m_active.set(self.num_active)
+        self._m_queue.set(len(self._queue))
+        self._update_pool_gauges()
+        self._sample_traces()
 
     @property
     def num_active(self):

@@ -676,11 +676,17 @@ class TrainStep:
     """
 
     def __init__(self, model, optimizer, loss_fn=None, donate=True,
-                 with_outputs=False, accumulate_steps=1, scaler=None):
+                 with_outputs=False, accumulate_steps=1, scaler=None,
+                 telemetry=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.with_outputs = with_outputs
+        # observability.TrainingTelemetry: when attached, each __call__
+        # is timed end-to-end (blocking on the loss so the histogram
+        # sees device time, not async dispatch) and recorded as one
+        # step observation — the only sync telemetry costs
+        self.telemetry = telemetry
         # gradient merge (GradientMergeOptimizer k_steps analog): grads
         # from K successive micro-batch calls accumulate in device
         # buffers; the optimizer applies the MEAN on the K-th call
@@ -931,6 +937,44 @@ class TrainStep:
         return jax.jit(scan_all, donate_argnums=donate)
 
     def __call__(self, *inputs, label=None):
+        if self.telemetry is None:
+            return self._call_inner(*inputs, label=label)
+        import time
+
+        # gradient merge: the K micro-batch calls of one optimizer step
+        # record ONE observation, timed cycle-start to K-th-call-loss
+        # with a single block — mid-cycle calls stay async so telemetry
+        # doesn't serialize the dispatch pipeline
+        if getattr(self, "_tel_t0", None) is None:
+            self._tel_t0 = time.perf_counter()
+        try:
+            out = self._call_inner(*inputs, label=label)
+        except BaseException:
+            # a failed micro-batch must not leave the cycle timer armed
+            # — the next successful cycle would observe failure + idle
+            # time as one giant step. If earlier micro-batches of this
+            # cycle already ran, the cycle completes with a PARTIAL
+            # re-armed timer: taint it so no skewed observation lands.
+            self._tel_t0 = None
+            if self.accumulate_steps > 1 and self._accum_count != 0:
+                self._tel_taint = True
+            raise
+        if self.accumulate_steps > 1 and self._accum_count != 0:
+            return out                     # mid-cycle micro-batch
+        if getattr(self, "_tel_taint", False):
+            self._tel_taint = False        # tainted cycle: no sample
+            self._tel_t0 = None
+            return out
+        loss_t = out[0] if isinstance(out, tuple) else out
+        jax.block_until_ready(loss_t._array)
+        dt = time.perf_counter() - self._tel_t0
+        self._tel_t0 = None
+        loss_val = float(loss_t._array) \
+            if getattr(loss_t._array, "size", 0) == 1 else None
+        self.telemetry.observe_step(dt, loss=loss_val)
+        return out
+
+    def _call_inner(self, *inputs, label=None):
         if label is None and len(inputs) >= 2:
             *inputs, label = inputs
             inputs = tuple(inputs)

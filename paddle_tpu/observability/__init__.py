@@ -1,0 +1,38 @@
+"""Unified metrics & telemetry — the counters/gauges/histograms half of
+observability (the profiler package holds the span/trace half).
+
+    from paddle_tpu import observability as obs
+
+    reg = obs.get_registry()                    # process-default registry
+    reqs = reg.counter("requests_total", "...", labelnames=("verb",))
+    reqs.labels(verb="GET").inc()
+
+    print(reg.render_prometheus())              # text exposition
+    srv = obs.MetricsServer(reg)                # loopback /metrics
+    merged = obs.aggregate()                    # fold across ranks
+
+Importing this package has no JAX side effects (no backend/device
+init); the distributed fold and memory sampling import lazily.
+"""
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    merge_snapshots,
+    quantile_from_buckets,
+    series_total,
+)
+from .aggregate import aggregate
+from .exposition import parse_prometheus, render_prometheus
+from .server import MetricsServer
+from .training import TrainingTelemetry
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "LATENCY_BUCKETS", "merge_snapshots", "quantile_from_buckets",
+    "series_total", "aggregate", "render_prometheus",
+    "parse_prometheus", "MetricsServer", "TrainingTelemetry",
+]

@@ -1,0 +1,70 @@
+"""Distributed metric aggregation: fold per-rank snapshots to job-level.
+
+Every rank in the group calls `aggregate()` (collective contract — same
+order on every member, like any ProcessGroup op); each receives the
+merged result, so rank 0 can expose job-level numbers on its `/metrics`
+endpoint while the others stay silent — serve the merged dict via
+`MetricsServer(snapshot_fn=...)`, refreshing it from the job loop (the
+scrape path must never trigger the collective itself). The fold rides the eager
+collective tier (one device per process on the dp×mp CPU/TPU mesh):
+
+1. the local `MetricsRegistry.snapshot()` is serialized to JSON bytes;
+2. payload sizes are MAX-all_reduced so every rank pads to one shape
+   (collectives are shape-static);
+3. one all_gather moves every rank's padded payload everywhere;
+4. `merge_snapshots` folds them on the host — counters and histogram
+   buckets sum EXACTLY (fixed explicit bounds, no re-bucketing),
+   gauges report min/max/mean.
+
+Registries are host-side state, so the data plane is a gather, not an
+in-graph psum — metric cardinality differs per rank (a rank that never
+stalled has no stall series) and a fixed-schema reduction would either
+drop series or force global schema negotiation every scrape.
+"""
+from __future__ import annotations
+
+import json
+
+from .metrics import get_registry, merge_snapshots
+
+__all__ = ["aggregate"]
+
+
+def aggregate(group=None, registry=None):
+    """Merge every group member's registry snapshot; returns the merged
+    snapshot dict on ALL members. With one participant (or outside a
+    distributed context) this degenerates to the local snapshot run
+    through the same merge path."""
+    reg = registry if registry is not None else get_registry()
+    local = reg.snapshot()
+
+    from paddle_tpu.distributed import collective as C
+
+    ranks = C._member_ranks(group)
+    if len(ranks) <= 1:
+        return merge_snapshots([local])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.core.tensor import Tensor
+
+    payload = json.dumps(local, sort_keys=True).encode()
+    n = Tensor._wrap(jnp.asarray(np.array([len(payload)], np.int32)))
+    C.all_reduce(n, op=C.ReduceOp.MAX, group=group)
+    cap = int(np.asarray(n._array)[0])
+
+    # [1 + cap] int32: actual length, then payload bytes, zero-padded
+    vec = np.zeros(1 + cap, np.int32)
+    vec[0] = len(payload)
+    vec[1:1 + len(payload)] = np.frombuffer(payload, np.uint8)
+    gathered: list = []
+    C.all_gather(gathered, Tensor._wrap(jnp.asarray(vec)), group=group)
+
+    snaps = []
+    for t in gathered:
+        a = np.asarray(t._array)
+        ln = int(a[0])
+        snaps.append(json.loads(
+            a[1:1 + ln].astype(np.uint8).tobytes().decode()))
+    return merge_snapshots(snaps)

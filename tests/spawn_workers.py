@@ -20,3 +20,35 @@ def allreduce_worker(out_dir):
 
 def failing_worker():
     raise ValueError("boom from a rank")
+
+
+def record_metric_events(reg, rank):
+    """Deterministic per-rank metric trace, shared by the aggregation
+    worker and the test's single-process replay so the two folds see
+    bit-identical events."""
+    c = reg.counter("w_requests_total", "requests", labelnames=("verb",))
+    for _ in range(rank + 1):
+        c.labels(verb="GET").inc()
+    if rank % 2:
+        c.labels(verb="PUT").inc(2)          # series absent on even ranks
+    reg.gauge("w_depth", "queue depth").set(10.0 * rank + 1.0)
+    h = reg.histogram("w_latency_seconds", "latency",
+                      buckets=(0.001, 0.01, 0.1, 1.0))
+    for i in range(3 * (rank + 1)):
+        h.observe(0.0007 * (i + 1) * (rank + 1))
+
+
+def metrics_aggregate_worker(out_dir):
+    """Each rank records its own events, then folds snapshots over the
+    group collectives; every rank writes the merged result (they must
+    agree — the fold is a collective)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.observability import MetricsRegistry, aggregate
+
+    dist.init_parallel_env()
+    r = dist.get_rank()
+    reg = MetricsRegistry()
+    record_metric_events(reg, r)
+    merged = aggregate(registry=reg)
+    with open(os.path.join(out_dir, f"agg_rank{r}.json"), "w") as f:
+        json.dump(merged, f, sort_keys=True)

@@ -1,0 +1,425 @@
+"""Observability subsystem tests (ISSUE 2 tentpole): metrics registry
+semantics, Prometheus exposition golden + round-trip, loopback-only
+/metrics endpoint on an ephemeral port, exact cross-rank snapshot
+merges (including a real 4-process fold over the collectives), training
+telemetry, the nan/inf event counter, and the hapi MetricsLogger glue.
+
+Reference analogs: the profiler/monitor layers reproduce the span half;
+this is the counters/gauges/histograms half serving systems scrape
+(Orca/vLLM-style TTFT/TPOT/utilization reporting).
+"""
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _registry():
+    from paddle_tpu.observability import MetricsRegistry
+
+    return MetricsRegistry()
+
+
+# -- registry semantics ----------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = _registry()
+    c = reg.counter("reqs_total", "Requests.")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+
+    g = reg.gauge("depth", "Depth.")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3.0
+    g.set_max(10)
+    g.set_max(5)                     # high-water keeps the max
+    assert g.value == 10.0
+
+    h = reg.histogram("lat_seconds", "Latency.", buckets=(0.1, 1.0))
+    for v in (0.05, 0.1, 0.5, 7.0):  # bounds are inclusive
+        h.observe(v)
+    assert h.count == 4 and h.sum == pytest.approx(7.65)
+    snap = reg.snapshot()
+    assert snap["lat_seconds"]["series"][0]["counts"] == [2, 1, 1]
+    assert snap["reqs_total"]["type"] == "counter"
+    assert snap["depth"]["type"] == "gauge"
+
+
+def test_labeled_series_semantics():
+    reg = _registry()
+    c = reg.counter("hits_total", "Hits.", labelnames=("verb", "code"))
+    c.labels(verb="GET", code=200).inc()
+    c.labels("GET", "200").inc()             # same series, positional
+    c.labels(verb="PUT", code=500).inc(3)
+    snap = reg.snapshot()["hits_total"]
+    assert snap["labelnames"] == ["verb", "code"]
+    series = {tuple(s["labels"].items()): s["value"]
+              for s in snap["series"]}
+    assert series[(("verb", "GET"), ("code", "200"))] == 2.0
+    assert series[(("verb", "PUT"), ("code", "500"))] == 3.0
+
+    with pytest.raises(ValueError, match="missing label"):
+        c.labels(verb="GET")
+    with pytest.raises(ValueError, match="takes 2 label"):
+        c.labels("GET")
+    with pytest.raises(ValueError, match="is labeled"):
+        c.inc()                              # labeled family needs labels
+    # idempotent re-registration returns the same family...
+    assert reg.counter("hits_total", labelnames=("verb", "code")) is c
+    # ...and a conflicting declaration is loud
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("hits_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("hits_total", labelnames=("verb",))
+    with pytest.raises(ValueError, match="reserved"):
+        reg.histogram("h2", labelnames=("le",))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        reg.counter("bad-name")
+
+
+def test_prometheus_exposition_golden():
+    """Byte-exact golden for the text format the scraper ingests:
+    cumulative histogram buckets with +Inf, _sum/_count, labeled
+    counter series in sorted order, HELP/TYPE headers."""
+    reg = _registry()
+    c = reg.counter("requests_total", "Total requests.",
+                    labelnames=("verb",))
+    c.labels(verb="GET").inc()
+    c.labels(verb="GET").inc()
+    c.labels(verb="POST").inc(3)
+    reg.gauge("pool_utilization", "Used fraction.").set(0.25)
+    h = reg.histogram("latency_seconds", "Request latency.",
+                      buckets=(0.5, 1.0))
+    for v in (0.25, 0.5, 2.0):
+        h.observe(v)
+
+    golden = """\
+# HELP latency_seconds Request latency.
+# TYPE latency_seconds histogram
+latency_seconds_bucket{le="0.5"} 2
+latency_seconds_bucket{le="1"} 2
+latency_seconds_bucket{le="+Inf"} 3
+latency_seconds_sum 2.75
+latency_seconds_count 3
+# HELP pool_utilization Used fraction.
+# TYPE pool_utilization gauge
+pool_utilization 0.25
+# HELP requests_total Total requests.
+# TYPE requests_total counter
+requests_total{verb="GET"} 2
+requests_total{verb="POST"} 3
+"""
+    assert reg.render_prometheus() == golden
+
+
+def test_prometheus_round_trip():
+    from paddle_tpu.observability import parse_prometheus
+
+    reg = _registry()
+    c = reg.counter("c_total", "with \"quotes\" and \\slashes",
+                    labelnames=("k",))
+    c.labels(k='va"l\\ue').inc(7)
+    h = reg.histogram("h_seconds", "hist", buckets=(0.001, 0.1))
+    h.observe(0.05)
+    text = reg.render_prometheus()
+    parsed = parse_prometheus(text)
+    assert parsed["types"] == {"c_total": "counter",
+                               "h_seconds": "histogram"}
+    assert parsed["help"]["c_total"] == 'with "quotes" and \\slashes'
+    samples = {(n, tuple(sorted(l.items()))): v
+               for n, l, v in parsed["samples"]}
+    assert samples[("c_total", (("k", 'va"l\\ue'),))] == 7.0
+    assert samples[("h_seconds_bucket", (("le", "0.1"),))] == 1.0
+    assert samples[("h_seconds_bucket", (("le", "+Inf"),))] == 1.0
+    assert samples[("h_seconds_count", ())] == 1.0
+    with pytest.raises(ValueError, match="malformed"):
+        parse_prometheus("not a metric line\n")
+
+
+def test_merge_snapshots_exact_and_quantiles():
+    from paddle_tpu.observability import (
+        merge_snapshots, quantile_from_buckets, series_total,
+    )
+
+    regs = [_registry() for _ in range(3)]
+    for i, reg in enumerate(regs):
+        c = reg.counter("n_total", "count", labelnames=("kind",))
+        c.labels(kind="a").inc(i + 1)
+        if i == 2:
+            c.labels(kind="b").inc(10)       # series unique to rank 2
+        reg.gauge("g", "gauge").set(float(i))
+        h = reg.histogram("h_seconds", "hist", buckets=(0.1, 1.0))
+        for _ in range(i + 1):
+            h.observe(0.05)
+        h.observe(5.0)
+
+    merged = merge_snapshots([r.snapshot() for r in regs])
+    assert series_total(merged, "n_total") == 1 + 2 + 3 + 10
+    g = merged["g"]["series"][0]
+    assert (g["min"], g["max"], g["mean"]) == (0.0, 2.0, 1.0)
+    assert g["value"] == 1.0 and g["ranks"] == 3
+    hs = merged["h_seconds"]["series"][0]
+    assert hs["counts"] == [6, 0, 3] and hs["count"] == 9
+    assert hs["sum"] == pytest.approx(6 * 0.05 + 3 * 5.0)
+
+    # mismatched bucket bounds refuse to merge (exactness contract)
+    bad = _registry()
+    bad.histogram("h_seconds", "hist", buckets=(0.2, 2.0)).observe(0.1)
+    with pytest.raises(ValueError, match="bucket bounds differ"):
+        merge_snapshots([regs[0].snapshot(), bad.snapshot()])
+
+    # quantiles interpolate inside fixed buckets
+    assert quantile_from_buckets((1.0, 2.0), [0, 0], 0.5) is None
+    assert quantile_from_buckets((1.0, 2.0), [2, 2], 0.25) \
+        == pytest.approx(0.5)
+    assert quantile_from_buckets((1.0, 2.0), [2, 2], 0.75) \
+        == pytest.approx(1.5)
+    assert quantile_from_buckets((1.0, 2.0), [0, 1], 1.0) == 2.0
+
+
+def test_registry_reset_keeps_families_and_handles():
+    reg = _registry()
+    c = reg.counter("a_total", labelnames=("k",))
+    handle = c.labels(k="x")                    # cached hot-path handle
+    handle.inc(5)
+    h = reg.histogram("h_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    reg.reset()
+    snap = reg.snapshot()
+    assert snap["a_total"]["series"][0]["value"] == 0.0  # zeroed...
+    assert snap["h_seconds"]["series"][0]["count"] == 0
+    handle.inc()          # ...and cached handles STILL feed snapshots
+    assert reg.snapshot()["a_total"]["series"][0]["value"] == 1.0
+
+
+# -- /metrics endpoint -----------------------------------------------------
+
+def test_metrics_server_loopback_ephemeral_port():
+    from paddle_tpu.observability import MetricsServer, parse_prometheus
+
+    reg = _registry()
+    reg.counter("scraped_total", "Scrapes.").inc(4)
+    with MetricsServer(reg) as srv:
+        assert srv.port != 0                    # ephemeral, bound
+        assert srv.url.startswith("http://127.0.0.1:")
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        parsed = parse_prometheus(text)
+        assert ("scraped_total", {}, 4.0) in parsed["samples"]
+        with urllib.request.urlopen(srv.url + ".json",
+                                    timeout=10) as resp:
+            snap = json.load(resp)
+        assert snap == reg.snapshot()
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/other", timeout=10)
+    with pytest.raises(ValueError, match="loopback-only"):
+        MetricsServer(reg, host="0.0.0.0")
+
+
+def test_observability_import_has_no_device_init_side_effects():
+    """Tier-1 smoke: importing the package must not initialize a JAX
+    backend (a metrics thread on a serving host must not race device
+    init) and must work end-to-end without one."""
+    code = (
+        "import paddle_tpu.observability as obs\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, 'backend initialized'\n"
+        "r = obs.MetricsRegistry()\n"
+        "r.counter('a_total').inc()\n"
+        "assert 'a_total 1' in r.render_prometheus()\n"
+        "assert not xla_bridge._backends, 'render touched a backend'\n"
+        "print('SMOKE_OK')\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SMOKE_OK" in res.stdout
+
+
+# -- distributed aggregation ----------------------------------------------
+
+def test_aggregate_single_process_degenerates():
+    from paddle_tpu.observability import aggregate, merge_snapshots
+
+    reg = _registry()
+    reg.counter("solo_total").inc(3)
+    merged = aggregate(registry=reg)
+    assert merged == merge_snapshots([reg.snapshot()])
+    assert merged["solo_total"]["series"][0]["value"] == 3.0
+
+
+def test_aggregate_four_rank_parity(tmp_path):
+    """Acceptance: aggregate() over a 4-process group returns exact
+    counter sums and exact merged histogram buckets, verified against a
+    single-process replay of the same per-rank event traces through
+    merge_snapshots. Every rank must also agree on the result (the
+    fold is a collective)."""
+    from tests.spawn_workers import (
+        metrics_aggregate_worker, record_metric_events,
+    )
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.observability import MetricsRegistry, merge_snapshots
+
+    dist.spawn(metrics_aggregate_worker, args=(str(tmp_path),),
+               nprocs=4, backend="cpu")
+
+    snaps = []
+    for r in range(4):
+        reg = MetricsRegistry()
+        record_metric_events(reg, r)
+        snaps.append(reg.snapshot())
+    expected = json.loads(json.dumps(merge_snapshots(snaps),
+                                     sort_keys=True))
+
+    for r in range(4):
+        with open(tmp_path / f"agg_rank{r}.json") as f:
+            got = json.load(f)
+        assert got == expected, f"rank {r} merged snapshot diverged"
+    # spot-check the exactness the JSON equality already implies
+    assert got["w_requests_total"]["series"] == [
+        {"labels": {"verb": "GET"}, "value": 10.0},
+        {"labels": {"verb": "PUT"}, "value": 4.0},
+    ]
+    total = sum(3 * (r + 1) for r in range(4))
+    assert sum(got["w_latency_seconds"]["series"][0]["counts"]) == total
+
+
+# -- training telemetry + nan/inf counter ----------------------------------
+
+def test_training_telemetry_and_trainstep_integration():
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.observability import TrainingTelemetry
+
+    reg = _registry()
+    tel = TrainingTelemetry(registry=reg, tokens_per_step=64)
+    tel.observe_step(0.5, grad_norm=1.25, loss=0.75)
+    snap = reg.snapshot()
+    assert snap["train_steps_total"]["series"][0]["value"] == 1.0
+    assert snap["train_tokens_total"]["series"][0]["value"] == 64.0
+    assert snap["train_tokens_per_second"]["series"][0]["value"] == 128.0
+    assert snap["train_grad_norm"]["series"][0]["value"] == 1.25
+    assert snap["train_loss"]["series"][0]["value"] == 0.75
+
+    # memory watermark gauges ride device/memory.py
+    stats = tel.record_memory()
+    snap = reg.snapshot()
+    kinds = {s["labels"]["kind"]: s["value"]
+             for s in snap["train_device_memory_bytes"]["series"]}
+    assert kinds["allocated"] == float(stats["allocated_bytes"])
+    assert kinds["peak"] >= kinds["allocated"] - 1e-9
+
+    # TrainStep(..., telemetry=...) times real compiled steps
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    step = jit.TrainStep(net, opt, F.mse_loss, telemetry=tel)
+    rs = np.random.RandomState(0)
+    x = paddle.to_tensor(rs.randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(rs.randn(4, 4).astype(np.float32))
+    for _ in range(3):
+        step(x, y)
+    snap = reg.snapshot()
+    assert snap["train_steps_total"]["series"][0]["value"] == 4.0
+    hist = snap["train_step_seconds"]["series"][0]
+    assert hist["count"] == 4 and hist["sum"] > 0
+    assert snap["train_loss"]["series"][0]["value"] > 0
+
+
+def test_nan_inf_event_counter():
+    import paddle_tpu as paddle
+    from paddle_tpu.observability import get_registry, series_total
+
+    before = series_total(get_registry().snapshot(),
+                          "nan_inf_events_total")
+    paddle.set_flags({"FLAGS_check_nan_inf": 1})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            x / x                              # 0/0 -> nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": 0})
+    after = series_total(get_registry().snapshot(),
+                         "nan_inf_events_total")
+    assert after == before + 1
+
+
+# -- hapi glue -------------------------------------------------------------
+
+def test_hapi_metrics_logger_callback():
+    from paddle_tpu.hapi.callbacks import MetricsLogger
+
+    reg = _registry()
+    cb = MetricsLogger(registry=reg)
+    cb.on_train_batch_end(0, {"loss": 0.5, "acc": [0.25],
+                              "note": "skipme"})
+    cb.on_train_batch_end(1, {"loss": 0.4})
+    cb.on_epoch_end(0, {"loss": 0.4})
+    cb.on_eval_end({"loss": 0.3, "acc": [0.5]})
+    snap = reg.snapshot()
+    assert snap["hapi_steps_total"]["series"][0]["value"] == 2.0
+    assert snap["hapi_epochs_total"]["series"][0]["value"] == 1.0
+    loss = {s["labels"]["phase"]: s["value"]
+            for s in snap["hapi_loss"]["series"]}
+    assert loss == {"train": 0.4, "eval": 0.3}
+    acc = {s["labels"]["phase"]: s["value"]
+           for s in snap["hapi_acc"]["series"]}
+    assert acc == {"train": 0.25, "eval": 0.5}
+    assert "hapi_note" not in snap                # non-numeric skipped
+
+
+# -- bench gate pending detection ------------------------------------------
+
+def test_check_bench_pending_logic(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_result as gate
+
+    base = {"op_a": {"ms": 1.0}}
+    path = os.path.join(REPO, "OPBENCH.json")
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(base, f)
+        tmp = f.name
+    try:
+        rc = gate.check_pending(tmp, suite_names=["op_a", "op_b"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "PENDING: op_b" in out
+        rc = gate.check_pending(tmp, suite_names=["op_a", "op_b"],
+                                strict=True)
+        capsys.readouterr()
+        assert rc == 1
+        rc = gate.check_pending(tmp, suite_names=["op_a"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "no pending rows" in out
+    finally:
+        os.unlink(tmp)
+    # the real OPBENCH.json has not adopted the PR-1 engine rows yet:
+    # the satellite exists precisely to make that visible
+    with open(path) as f:
+        real = json.load(f)
+    if "gpt_engine_offered_load" not in real:
+        rc = gate.check_pending(
+            path, suite_names=["gpt_engine_offered_load"])
+        out = capsys.readouterr().out
+        assert "PENDING: gpt_engine_offered_load" in out

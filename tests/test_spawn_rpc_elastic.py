@@ -245,3 +245,57 @@ def test_elastic_resize_consumes_only_absorbed_joiners():
         assert "late" not in m.live()                  # but absorbed
     finally:
         m.close()
+
+
+def test_elastic_registry_token_auth():
+    """ADVICE r5: a launcher-generated job token gates wire-level
+    register/leave/put; reads stay open for debugging. Tokenless
+    masters (direct test use) keep the open behavior."""
+    from paddle_tpu.distributed.launch.elastic import (
+        ElasticClient, ElasticMaster,
+    )
+
+    m = ElasticMaster(token="s3cret")
+    try:
+        anon = ElasticClient(m.endpoint, token="")
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            anon.register("rogue", ttl=30)
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            anon.put("k", "v")
+
+        ok = ElasticClient(m.endpoint, token="s3cret")
+        ok.register("good", ttl=30)
+        ok.put("k", "v")
+        assert "good" in m.live()            # authorized write landed
+        # heartbeat is authed too: a rogue replay must not keep a dead
+        # member's lease alive (phantom-member resize inflation)
+        assert ok.heartbeat("good") is True
+        assert anon.heartbeat("good") is False
+        assert "rogue" not in m.live()
+        # reads are open (the netcat-debuggability contract)
+        assert "good" in anon.live()
+        assert anon.get("k") == "v"
+        # rejected leave must not evict a live member
+        with pytest.raises(RuntimeError, match="unauthorized"):
+            anon.leave("good")
+        assert "good" in m.live()
+        ok.leave("good")
+        assert "good" not in m.live()
+
+        # env fallback: in-job workers pick the token up implicitly
+        os.environ["PADDLE_ELASTIC_TOKEN"] = "s3cret"
+        try:
+            envc = ElasticClient(m.endpoint)
+            envc.register("worker", ttl=30)
+            assert "worker" in m.live()
+        finally:
+            os.environ.pop("PADDLE_ELASTIC_TOKEN", None)
+    finally:
+        m.close()
+
+    m2 = ElasticMaster()                     # no token: open registry
+    try:
+        ElasticClient(m2.endpoint).register("anyone", ttl=30)
+        assert "anyone" in m2.live()
+    finally:
+        m2.close()

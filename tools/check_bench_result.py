@@ -13,6 +13,8 @@ Usage:
     # refresh the model baseline from a current run
     python tools/check_bench_result.py --bench current.jsonl \
         --baseline BENCH_BASELINE.json --update
+    # rows the suite produces that the op baseline has never adopted
+    python tools/check_bench_result.py --pending OPBENCH.json [--strict]
 
 Model rows compare `value` (throughput: higher is better); op rows
 compare `ms` (lower is better). A metric present in the baseline but
@@ -23,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -93,12 +96,55 @@ def check_ops(current, baseline, threshold):
     return failures, notes
 
 
+def check_pending(baseline_path, suite_names=None, strict=False):
+    """Bench rows the suite produces that have NO baseline entry are
+    PENDING — they exist in code but the gate cannot see them until a
+    TPU `bench_ops.py --save` refresh adopts them (the silent-absence
+    failure mode: a new row looks tracked but regresses ungated).
+    Also flags stale baseline entries no current row produces."""
+    if suite_names is None:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        import bench_ops
+
+        # names only — suite() would eagerly allocate every case's
+        # device inputs just to read the keys
+        suite_names = bench_ops.suite_names()
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except FileNotFoundError:
+        baseline = {}
+    pending = [n for n in suite_names if n not in baseline]
+    stale = [n for n in baseline if n not in suite_names]
+    for n in pending:
+        print(f"PENDING: {n} — in the bench_ops suite but absent from "
+              f"{baseline_path}; adopt it with a TPU "
+              "`bench_ops.py --save` refresh")
+    for n in stale:
+        print(f"note: {n}: in {baseline_path} but no suite row "
+              "produces it (stale baseline entry)")
+    if not pending:
+        print(f"no pending rows ({len(suite_names)} suite rows all "
+              f"tracked by {baseline_path})")
+        return 0
+    print(f"{len(pending)} pending row(s) not gated")
+    return 1 if strict else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     g = ap.add_mutually_exclusive_group(required=True)
     g.add_argument("--bench", help="bench.py JSON-lines file or '-'")
     g.add_argument("--opbench", help="bench_ops.py --save style file")
-    ap.add_argument("--baseline", required=True)
+    g.add_argument("--pending", metavar="OPBENCH",
+                   help="list bench_ops suite rows missing from this "
+                        "baseline as PENDING (report-only unless "
+                        "--strict)")
+    ap.add_argument("--strict", action="store_true",
+                    help="with --pending: exit 1 when any row is "
+                         "pending")
+    ap.add_argument("--baseline")
     ap.add_argument("--threshold", type=float, default=None,
                     help="allowed fractional regression "
                          "(default 0.10 model / 0.25 op)")
@@ -107,6 +153,13 @@ def main(argv=None):
                          "instead of checking")
     args = ap.parse_args(argv)
 
+    if args.pending:
+        if args.update or args.baseline or args.threshold is not None:
+            ap.error("--pending is report-only; it takes no "
+                     "--update/--baseline/--threshold")
+        return check_pending(args.pending, strict=args.strict)
+    if not args.baseline:
+        ap.error("--baseline is required with --bench/--opbench")
     if args.bench:
         current = load_bench_lines(args.bench)
         threshold = 0.10 if args.threshold is None else args.threshold

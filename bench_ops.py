@@ -100,6 +100,7 @@ SUITE_ROWS = (
     "flash_attention_2k", "layernorm_2048", "softmax_xent_50k",
     "embedding_50k", "reduce_sum_64M", "gpt_decode_kv_32tok",
     "gpt_decode_kv_350m", "gpt_engine_offered_load",
+    "paged_attention_decode_sweep", "gpt_engine_offered_load_pallas",
 )
 
 
@@ -193,6 +194,9 @@ def suite():
     # (CPU CI imports it), run() resolves the callables when measuring
     cases["gpt_decode_kv_350m"] = _decode_350m_case
     cases["gpt_engine_offered_load"] = _engine_offered_load_case()
+    cases["paged_attention_decode_sweep"] = _paged_attention_sweep_case()
+    cases["gpt_engine_offered_load_pallas"] = _engine_offered_load_case(
+        attention_backend="pallas")
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -268,9 +272,76 @@ def _decode_350m_case():
     return (decode, (fuzz,), flops, {"tokens": B * new_tokens})
 
 
+def _paged_attention_sweep_case(num_slots=8, heads=16, head_dim=128,
+                                block_size=16, max_model_len=2048,
+                                ctx_lengths=(128, 512, 2048),
+                                backends=("dense", "pallas"),
+                                dtype=None, seed=17):
+    """ISSUE-3 paged-attention microbench: one decode-attention step
+    (fused KV write + attention over the slot's cached context) at a
+    FIXED max_model_len while the ACTIVE context sweeps `ctx_lengths`,
+    timed per backend. The sweep is the O(active-context) evidence the
+    tentpole claims: the dense fallback's per-step time must track the
+    active-context high-water mark (its fori_loop trip count), not sit
+    flat at the max_model_len cost PR 1's full-table gather paid, and
+    the pallas kernel must track it with a lower slope (per-slot
+    block streaming instead of a batch gather). Headline `ms` is the
+    pallas full-context time — the fused kernel is what this row
+    tracks; the per-backend curves ride in the record. Lazy-built like
+    every heavy inference row; tests call it at a tiny shape (pallas
+    runs interpreted off-TPU)."""
+
+    def run_bench():
+        import paddle_tpu  # noqa: F401  (registers ops)
+        from paddle_tpu.ops.paged_attention import paged_attention_step
+
+        dt = dtype or jnp.bfloat16
+        max_blocks = max(max_model_len // block_size, 1)
+        num_blocks = 1 + num_slots * max_blocks
+        L = 1                            # one layer plane: the op cost
+        kpool = _rand((L, num_blocks, block_size, heads, head_dim), dt,
+                      seed=seed)
+        vpool = _rand((L, num_blocks, block_size, heads, head_dim), dt,
+                      seed=seed + 1)
+        # disjoint per-slot tables covering the whole budget; the sweep
+        # only moves `positions`, so every backend sees the same layout
+        tables = 1 + np.arange(num_slots * max_blocks, dtype=np.int32) \
+            .reshape(num_slots, max_blocks)
+        q = _rand((num_slots, 1, heads, head_dim), dt, seed=seed + 2)
+        k_new = _rand((num_slots, 1, heads, head_dim), dt, seed=seed + 3)
+        v_new = _rand((num_slots, 1, heads, head_dim), dt, seed=seed + 4)
+
+        curves = {b: {} for b in backends}
+        for ctx in ctx_lengths:
+            positions = np.full(num_slots, ctx - 1, np.int32)
+            for b in backends:
+                # pools ride in the closure (the _decode_350m_case
+                # idiom), NOT as _timeit args: salting them would add
+                # an O(pool-size) element-wise pass per iteration that
+                # swamps the O(active-context) attention traffic this
+                # row exists to expose; q/k/v salting alone keeps the
+                # step off the loop-invariant path
+                def step(qa, ka, va, _b=b, _pos=positions):
+                    out, _, _ = paged_attention_step(
+                        qa, ka, va, kpool, vpool, 0, tables, _pos,
+                        backend=_b)
+                    return out._array
+                ms = _timeit(step, q, k_new, v_new)
+                curves[b][str(ctx)] = round(ms, 4)
+        head = "pallas" if "pallas" in curves else backends[0]
+        rec = {"ms": curves[head][str(ctx_lengths[-1])],
+               "max_model_len": max_model_len,
+               "block_size": block_size}
+        for b in backends:
+            rec[f"{b}_ms_by_ctx"] = curves[b]
+        return rec
+
+    return run_bench
+
+
 def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
                               block_size=16, prefill_buckets=None,
-                              seed=0):
+                              seed=0, attention_backend=None):
     """Engine-level offered-load row: the continuous-batching engine
     (paged KV cache + slot scheduler, inference/engine.py) serving a
     mixed trace of prompts/output lengths; the metric is AGGREGATE new
@@ -285,7 +356,10 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
     just aggregate tokens/s — warmup observations are dropped by a
     registry reset before the measured window.
     Returns a zero-arg runner producing the result record (run()
-    resolves it); tests call it with a tiny config."""
+    resolves it); tests call it with a tiny config.
+    `attention_backend` selects the paged-attention kernel
+    (`gpt_engine_offered_load_pallas` is this same trace with
+    attention_backend='pallas' — the fused-kernel serving number)."""
 
     def run_bench():
         import time
@@ -313,7 +387,19 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
             if b <= cfg.max_seq_len)
         engine = GenerationEngine(model, num_slots=num_slots,
                                   block_size=block_size,
-                                  prefill_buckets=buckets)
+                                  prefill_buckets=buckets,
+                                  attention_backend=attention_backend)
+        if attention_backend and \
+                engine.attention_backend != attention_backend:
+            # the env knob overrides the constructor (deploy semantics)
+            # — but a bench row NAMED for a backend must never record
+            # another backend's numbers under that name
+            raise RuntimeError(
+                f"bench row requested attention_backend="
+                f"{attention_backend!r} but the engine resolved "
+                f"{engine.attention_backend!r} (is "
+                "PADDLE_PAGED_ATTENTION_BACKEND set?) — unset it to "
+                "run this row")
         # warm every compiled program the trace will hit (bucketed
         # prefill per bucket + the one decode step), then measure
         for b in sorted({engine._bucket_for(p) for p, _ in reqs}):
@@ -344,6 +430,7 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
 
         return {"ms": round(dt * 1e3, 1),
                 "tokens_per_s": round(new_toks / dt),
+                "attention_backend": engine.attention_backend,
                 "requests": len(reqs),
                 "ttft_ms_p50": pct_ms("engine_ttft_seconds", 0.5),
                 "ttft_ms_p99": pct_ms("engine_ttft_seconds", 0.99),

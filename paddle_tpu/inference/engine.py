@@ -31,7 +31,14 @@ recompiles:
   never retrace — `jit.count_traces` probes prove it in CI.
 
 Greedy decoding matches `GPTForCausalLM.generate(use_cache=True)`
-token-for-token per request (the parity contract CI enforces).
+token-for-token per request (the parity contract CI enforces) — under
+either paged-attention backend: `attention_backend` (or the
+`PADDLE_PAGED_ATTENTION_BACKEND` env override) picks `auto` / `dense` /
+`pallas` per `ops.paged_attention.resolve_backend`, resolved once at
+construction so the compiled decode step is fixed; the selection is
+published as the `engine_attention_backend_info` gauge and every decode
+dispatch lands in the backend-labeled `engine_decode_step_seconds`
+histogram.
 
 Serving telemetry (PR 2): every engine carries a metrics registry
 (`engine.metrics`, observability tier) — TTFT/TPOT histograms, queue/
@@ -45,6 +52,7 @@ metrics story.
 from __future__ import annotations
 
 import math
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -147,7 +155,9 @@ class GenerationEngine:
     def __init__(self, model, num_slots=8, block_size=16,
                  num_blocks=None, prefill_buckets=None,
                  max_model_len=None, eos_token_id=None, donate=None,
-                 registry=None):
+                 registry=None, attention_backend=None):
+        from paddle_tpu.ops.paged_attention import resolve_backend
+
         cfg = model.config
         if model.training and cfg.dropout > 0:
             raise ValueError("GenerationEngine decodes deterministically "
@@ -177,6 +187,16 @@ class GenerationEngine:
             raise ValueError("largest prefill bucket "
                              f"({self.prefill_buckets[-1]}) must cover "
                              f"max_model_len={self.max_model_len}")
+        # paged-attention kernel backend: constructor arg, overridden by
+        # the env (deploy-time switch without a code change), resolved
+        # ONCE to a concrete backend so the compiled decode step is
+        # fixed — `auto` never changes mid-engine (decode traces == 1)
+        requested = os.environ.get("PADDLE_PAGED_ATTENTION_BACKEND") \
+            or attention_backend or "auto"
+        self.attention_backend_requested = requested
+        self.attention_backend = resolve_backend(
+            requested, head_dim=cfg.hidden_size // cfg.num_heads,
+            block_size=self.block_size)
         # the state threading of TrainStep: params+buffers ride as traced
         # args, so weight updates are visible without retracing
         self._state = dedup_params(list(model.parameters())) + \
@@ -246,6 +266,19 @@ class GenerationEngine:
             "engine_decode_recompiles_total",
             "Decode retraces past the first compile — nonzero means a "
             "shape-stability bug.")
+        self._m_backend = m.gauge(
+            "engine_attention_backend_info",
+            "Paged-attention kernel backend the compiled decode step "
+            "dispatches to (1 = selected).", labelnames=("backend",))
+        self._m_backend.labels(backend=self.attention_backend).set(1)
+        # the backend label is fixed at construction: resolve the
+        # histogram child once, off the per-step path
+        self._m_decode_seconds = m.histogram(
+            "engine_decode_step_seconds",
+            "Wall time of one compiled decode dispatch, labeled by "
+            "paged-attention backend.", labelnames=("backend",),
+            buckets=LATENCY_BUCKETS).labels(
+                backend=self.attention_backend)
         self._decode_traces_seen = 0
 
     def _update_pool_gauges(self):
@@ -280,6 +313,7 @@ class GenerationEngine:
 
     def _build_decode(self):
         model, state = self.model, self._state
+        backend = self.attention_backend
 
         def decode_fn(state_arrays, kpool, vpool, tokens, positions,
                       tables):
@@ -287,7 +321,7 @@ class GenerationEngine:
                 h, kp, vp = model.gpt.forward_decode_paged(
                     Tensor._wrap(tokens), Tensor._wrap(positions),
                     Tensor._wrap(kpool), Tensor._wrap(vpool),
-                    Tensor._wrap(tables))
+                    Tensor._wrap(tables), backend=backend)
                 logits = model._logits_of(h)          # [slots, 1, V]
                 nxt = jnp.argmax(logits._array[:, 0], axis=-1) \
                     .astype(jnp.int32)
@@ -472,11 +506,14 @@ class GenerationEngine:
                 positions[i] = slot.feed_pos
                 tables[i, :len(slot.blocks)] = slot.blocks
             with RecordEvent("engine.decode"):
+                t_dec = time.perf_counter()
                 nxt, self.cache.kpool, self.cache.vpool = self._decode(
                     self._state_arrays(), self.cache.kpool,
                     self.cache.vpool, jnp.asarray(tokens),
                     jnp.asarray(positions), jnp.asarray(tables))
                 nxt = np.asarray(nxt)      # sync: tokens are out
+                self._m_decode_seconds.observe(
+                    time.perf_counter() - t_dec)
             now = time.perf_counter()
             for i in runnable:
                 slot = self._slots[i]

@@ -158,12 +158,14 @@ class GPTAttention(nn.Layer):
                 vcache)
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
-                             block_tables, positions):
+                             block_tables, positions, backend="auto"):
         """Batched one-token decode against the GLOBAL paged KV pool
         (the continuous-batching engine's layer step). x [slots,1,H];
         kpool/vpool [layers, num_blocks, block_size, heads, D];
         positions [slots] per-slot absolute positions; block_tables
-        [slots, max_blocks]. Returns (out, new_kpool, new_vpool)."""
+        [slots, max_blocks]; backend is the paged-attention kernel
+        selector (`auto`/`dense`/`pallas` — ops/paged_attention.py).
+        Returns (out, new_kpool, new_vpool)."""
         from paddle_tpu.ops.paged_attention import paged_attention_step
 
         B, S, H = x.shape  # S == 1
@@ -171,7 +173,8 @@ class GPTAttention(nn.Layer):
         qkv = mp.reshape(qkv, [B, 1, 3, self.num_heads, self.head_dim])
         q, k, v = mp.unbind(qkv, axis=2)
         out, kpool, vpool = paged_attention_step(
-            q, k, v, kpool, vpool, layer_idx, block_tables, positions)
+            q, k, v, kpool, vpool, layer_idx, block_tables, positions,
+            backend=backend)
         return self.out_proj(mp.reshape(out, [B, 1, H])), kpool, vpool
 
 
@@ -234,10 +237,10 @@ class GPTBlock(nn.Layer):
         return x + self.mlp(self.ln2(x)), kcache, vcache
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
-                             block_tables, positions):
+                             block_tables, positions, backend="auto"):
         a, kpool, vpool = self.attn.forward_decode_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
-            positions)
+            positions, backend=backend)
         x = x + a
         return x + self.mlp(self.ln2(x)), kpool, vpool
 
@@ -302,20 +305,24 @@ class GPTModel(nn.Layer):
                 mp.stack(nvs, axis=0))
 
     def forward_decode_paged(self, token_ids, positions, kpool, vpool,
-                             block_tables):
+                             block_tables, backend="auto"):
         """Batched decode step over the paged pool (continuous-batching
         engine path): token_ids [slots,1], positions [slots] int32
         per-slot absolute positions, kpool/vpool
         [num_layers, num_blocks, block_size, heads, D], block_tables
-        [slots, max_blocks]. Returns (hidden [slots,1,H], new_kpool,
-        new_vpool) — pool updates chain functionally through the layers
-        and alias in place under the engine's donated compiled step."""
+        [slots, max_blocks], backend the paged-attention kernel
+        selector (`auto`/`dense`/`pallas`, resolved per layer step in
+        ops/paged_attention.py). Returns (hidden [slots,1,H],
+        new_kpool, new_vpool) — pool updates chain functionally through
+        the layers and alias in place under the engine's donated
+        compiled step."""
         pos_t = positions.astype("int32") if hasattr(positions, "astype") \
             else paddle.to_tensor(positions, dtype="int32")
         h = self.wte(token_ids) + self.wpe(pos_t).unsqueeze(1)
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_decode_paged(
-                h, kpool, vpool, i, block_tables, pos_t)
+                h, kpool, vpool, i, block_tables, pos_t,
+                backend=backend)
         return self.ln_f(h), kpool, vpool
 
 

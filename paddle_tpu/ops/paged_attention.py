@@ -10,17 +10,32 @@ NULL block: idle decode slots and padded prefill positions write there,
 and no allocator ever hands it out, so garbage writes can never alias a
 live request's context.
 
-Implementation notes (the dense-gather fallback):
-- the per-step attention GATHERS each slot's blocks back into a
-  contiguous `[slots, max_len, heads, head_dim]` view and runs plain
-  masked attention — O(max_len) HBM traffic per slot per step, which is
-  exactly what a fused Pallas paged-attention kernel (one core per
-  slot, block-table-driven async copies HBM->VMEM) would remove. The
-  helper is the single seam where that kernel slots in; everything
-  above it (engine, model, tests) is layout-agnostic.
-- functional `.at[].set` writes chain through the layer stack; under
-  the engine's donated compiled step XLA aliases them in place, so the
-  pool is updated in HBM, not copied per layer.
+`paged_attention_step` is a backend-dispatching seam:
+
+- `"pallas"`: the fused TPU kernel (`ops/pallas/paged_attention.py`) —
+  one program per slot walks the block table and streams only the
+  blocks at or below that slot's position from HBM into VMEM.
+  O(active context) HBM traffic per slot per step. Off-TPU it runs
+  through the Pallas interpreter (CPU CI tests it token-exactly).
+- `"dense"`: an XLA fallback that online-softmaxes over a
+  `lax.fori_loop` bounded by the BATCH's high-water block count
+  (`max(positions) // block_size + 1`) — O(high-water) work per step
+  instead of the O(max_model_len) full-table gather PR 1 shipped. The
+  trip count is a traced scalar, so one compiled program serves every
+  context depth (the engine's decode-traces == 1 contract holds).
+- `"auto"`: resolves per `resolve_backend` — pallas on TPU at
+  serving-scale shapes, dense otherwise (see DESIGN_DECISIONS:
+  "Paged-attention backend crossover").
+
+Numerics (both backends): logits and the online-softmax state are
+fp32; the PV product accumulates in fp32 (`preferred_element_type`)
+and the output is cast to q.dtype ONCE at the end — a bf16 pool loses
+only the matmul-input rounding, not the accumulation.
+
+Implementation notes:
+- functional `.at[].set` / aliased-pool writes chain through the layer
+  stack; under the engine's donated compiled step XLA aliases them in
+  place, so the pool is updated in HBM, not copied per layer.
 - scatter/gather indices are per-slot vectors: one program serves any
   mix of slot positions (shape-stable steady-state decode — no
   per-request recompiles).
@@ -35,11 +50,59 @@ import jax.numpy as jnp
 from .dispatch import apply, as_tensor
 
 __all__ = ["paged_attention_step", "paged_prefill_write",
-           "dense_gather_reference"]
+           "dense_gather_reference", "resolve_backend",
+           "PAGED_BACKENDS", "PAGED_PATH_STATS"]
+
+PAGED_BACKENDS = ("auto", "dense", "pallas")
+
+# which backend paged_attention_step dispatched to, incremented per
+# call (so per TRACE under jit — the engine's compiled decode bumps it
+# once per layer at compile time, never per step). Tests read it to
+# prove the requested kernel actually engaged; the engine's
+# kernel-backend gauge is set separately from resolve_backend() at
+# construction. flash_attention.PATH_STATS precedent: never a silent
+# fallback.
+PAGED_PATH_STATS = {"dense": 0, "pallas": 0}
+
+
+def reset_paged_path_stats():
+    PAGED_PATH_STATS["dense"] = 0
+    PAGED_PATH_STATS["pallas"] = 0
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu" or \
+            jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def resolve_backend(backend, head_dim, block_size):
+    """Resolve `auto`/`dense`/`pallas` to the backend a step will run.
+
+    `auto` picks the fused kernel only on TPU and only at
+    serving-scale shapes — head_dim >= 64 (the MXU lane width the
+    kernel's per-block einsum needs to not run mostly-padded) and
+    block_size >= 8 (sublane multiple; smaller blocks make the
+    per-block DMA smaller than its descriptor overhead). Narrow-head /
+    tiny-block configs stay dense: at those shapes the per-slot grid +
+    per-block DMA overhead exceeds the gather traffic it saves —
+    mirroring the `_xla_attention_bf16` crossover note in
+    `ops/pallas/flash_attention.py`. Explicit `dense`/`pallas` always
+    wins (off-TPU, `pallas` runs the interpreter — the CPU CI path)."""
+    if backend not in PAGED_BACKENDS:
+        raise ValueError(f"backend must be one of {PAGED_BACKENDS}, "
+                         f"got {backend!r}")
+    if backend != "auto":
+        return backend
+    if _on_tpu() and head_dim >= 64 and block_size >= 8:
+        return "pallas"
+    return "dense"
 
 
 def paged_attention_step(q, k, v, kpool, vpool, layer, block_tables,
-                         positions, scale=None):
+                         positions, scale=None, backend="auto"):
     """One batched decode step against the paged cache, for one layer.
 
     q/k/v: `[slots, 1, heads, head_dim]` — this step's projections.
@@ -48,43 +111,89 @@ def paged_attention_step(q, k, v, kpool, vpool, layer, block_tables,
     block_tables: `[slots, max_blocks]` int32 pool-block ids per slot.
     positions: `[slots]` int32 — the incoming token's absolute position
     per slot (its write address; attention covers positions <= it).
+    backend: `auto` | `dense` | `pallas` (see module docstring).
 
     Writes k/v at `(block_tables[s, pos//bs], pos%bs)` per slot, then
-    attends q over the slot's gathered context. Idle slots are encoded
-    by the caller as (position 0, all-null table): they write into the
-    null block and attend garbage, and the engine discards their token.
-    Returns `(out [slots,1,heads,head_dim], new_kpool, new_vpool)`.
+    attends q over the slot's context. Idle slots are encoded by the
+    caller as (position 0, all-null table): they write into the null
+    block and attend only their own garbage row, and the engine
+    discards their token. Decode-only op: gradients are not defined
+    through it. Returns `(out [slots,1,heads,head_dim], new_kpool,
+    new_vpool)`.
     """
     q, k, v = as_tensor(q), as_tensor(k), as_tensor(v)
     kpool, vpool = as_tensor(kpool), as_tensor(vpool)
     block_tables, positions = as_tensor(block_tables), as_tensor(positions)
 
-    def fn(qa, ka, va, kp, vp, bt, pos):
-        B = qa.shape[0]
-        bs = kp.shape[2]
-        bid = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
-        off = pos % bs
-        kp = kp.at[layer, bid, off].set(ka[:, 0])
-        vp = vp.at[layer, bid, off].set(va[:, 0])
-        # gather the slot's context back contiguous (the part a Pallas
-        # paged kernel replaces with block-table-driven VMEM copies)
-        keys = kp[layer][bt]      # [B, max_blocks, bs, heads, D]
-        vals = vp[layer][bt]
-        T = bt.shape[1] * bs
-        keys = keys.reshape(B, T, keys.shape[3], keys.shape[4])
-        vals = vals.reshape(B, T, vals.shape[3], vals.shape[4])
-        d = qa.shape[-1]
-        s = scale if scale is not None else 1.0 / np.sqrt(d)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qa, keys,
-                            preferred_element_type=jnp.float32) * s
-        allowed = jnp.arange(T)[None, :] <= pos[:, None]     # [B, T]
-        logits = jnp.where(allowed[:, None, None, :], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, vals)
-        return out, kp, vp
+    resolved = resolve_backend(backend, head_dim=q.shape[3],
+                               block_size=kpool.shape[2])
+    PAGED_PATH_STATS[resolved] += 1
+    if resolved == "pallas":
+        from .pallas.paged_attention import paged_decode_attention
+
+        interpret = not _on_tpu()
+
+        def fn(qa, ka, va, kp, vp, bt, pos):
+            return paged_decode_attention(qa, ka, va, kp, vp, layer,
+                                          bt, pos, scale=scale,
+                                          interpret=interpret)
+    else:
+        def fn(qa, ka, va, kp, vp, bt, pos):
+            return _dense_step(qa, ka, va, kp, vp, layer, bt, pos,
+                               scale)
 
     return apply("paged_attention_step", fn, q, k, v, kpool, vpool,
                  block_tables, positions)
+
+
+def _dense_step(qa, ka, va, kp, vp, layer, bt, pos, scale):
+    """XLA fallback: per-block online softmax over a fori_loop bounded
+    by the batch high-water block count. Work per step is
+    O(max(positions)) — the live-context high-water mark — not
+    O(max_model_len) like a full-table gather; the traced trip count
+    keeps the program shape-stable (no recompiles as context grows)."""
+    B = qa.shape[0]
+    heads, d = qa.shape[2], qa.shape[3]
+    bs = kp.shape[2]
+    bid_w = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs
+    kp = kp.at[layer, bid_w, off].set(ka[:, 0])
+    vp = vp.at[layer, bid_w, off].set(va[:, 0])
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    # QK inputs stay at the pool dtype (bf16 MXU pass on TPU) with
+    # fp32 accumulation — the SAME policy as the pallas kernel, so the
+    # two backends see identical logits rounding and the cross-backend
+    # token-exact contract holds at bf16, not just fp32
+    qf = qa[:, 0].astype(kp.dtype)                 # [B, heads, d]
+    hw_blocks = jnp.max(pos) // bs + 1             # traced scalar
+
+    def body(j, carry):
+        m, l, acc = carry
+        bid = jax.lax.dynamic_index_in_dim(bt, j, axis=1,
+                                           keepdims=False)   # [B]
+        keys = kp[layer, bid]                      # [B, bs, heads, d]
+        vals = vp[layer, bid]
+        logits = jnp.einsum("bhd,bkhd->bhk", qf, keys,
+                            preferred_element_type=jnp.float32) * s
+        allowed = (j * bs + jnp.arange(bs))[None, :] <= pos[:, None]
+        logits = jnp.where(allowed[:, None, :], logits, -1e30)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)                # [B, heads, bs] f32
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        # PV accumulates in fp32 (preferred_element_type): probs enter
+        # the matmul at the pool dtype (bf16 MXU pass on TPU) but the
+        # product never rounds to bf16 mid-accumulation
+        pv = jnp.einsum("bhk,bkhd->bhd", p.astype(vals.dtype), vals,
+                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((B, heads, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, heads, 1), jnp.float32)
+    acc0 = jnp.zeros((B, heads, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, hw_blocks, body, (m0, l0, acc0))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)  # cast ONCE
+    return out[:, None], kp, vp
 
 
 def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):
@@ -122,7 +231,9 @@ def dense_gather_reference(kpool, vpool, layer, block_row, length):
     """Parity probe: reassemble one slot's first `length` cached k/v
     rows from the pools into dense `[length, heads, head_dim]` arrays
     (host-side, concrete values). Tests compare this against the dense
-    fixed-buffer cache the single-request decode path carries."""
+    fixed-buffer cache the single-request decode path carries — and,
+    across two engines, against each other (the pallas-vs-dense pool
+    parity probe)."""
     kp = np.asarray(as_tensor(kpool)._array)[layer]
     vp = np.asarray(as_tensor(vpool)._array)[layer]
     row = np.asarray(as_tensor(block_row)._array)

@@ -3,5 +3,7 @@ CUDA ops (paddle/fluid/operators/fused/): where XLA's automatic fusion
 isn't enough (flash attention, MoE block matmuls), we drop to Pallas.
 """
 from .flash_attention import flash_attention, pallas_sdpa_forward
+from .paged_attention import paged_decode_attention
 
-__all__ = ["flash_attention", "pallas_sdpa_forward"]
+__all__ = ["flash_attention", "pallas_sdpa_forward",
+           "paged_decode_attention"]

@@ -1,0 +1,212 @@
+"""Fused TPU paged-attention DECODE kernel — the kernel PR 1's
+`ops/paged_attention.py` left a seam for.
+
+One batched decode step against the vLLM-style paged KV pool
+(`[layers, num_blocks, block_size, heads, head_dim]`, block 0 = null):
+the grid runs one program per decode slot, and each program
+
+- writes the incoming token's k/v row into the pool at
+  `(block_table[pos // bs], pos % bs)` (fused KV write: the pool is an
+  input/output-aliased operand, so the write is an in-place DMA, not a
+  functional copy of the pool);
+- walks the slot's block table and STREAMS only the blocks at or below
+  its position from HBM into a double-buffered VMEM scratch
+  (`make_async_copy`, next block's DMA in flight behind the current
+  block's compute) — O(active context) HBM traffic per slot per step,
+  where the dense fallback pays O(high-water) and the PR-1 gather paid
+  O(max_model_len);
+- accumulates FlashAttention-style online softmax in fp32 across the
+  streamed blocks and normalizes once at the end.
+
+Null-block semantics are preserved: an idle slot (position 0, all-null
+table) writes its garbage row into block 0 and attends only position 0
+— a one-element softmax, finite by construction — and live slots never
+read a trailing-zero table entry because the walk stops at
+`pos // block_size`.
+
+Interpret mode (`interpret=True`) runs the same kernel through the
+Pallas interpreter, which is how CPU CI tests it token-exactly against
+the dense path; the op-tier seam (`ops/paged_attention.py`) forces
+interpret whenever no TPU is attached.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_decode_attention"]
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(bt_ref, pos_ref, q_ref, knew_ref, vnew_ref,
+                   kpool_in, vpool_in, o_ref, kpool_ref, vpool_ref,
+                   kbuf, vbuf, copy_sems, write_sems, *,
+                   layer, block_size, scale):
+    """One program per slot. bt_ref [slots, max_blocks] and pos_ref
+    [slots] are scalar-prefetch (SMEM) so DMA indices are computable
+    before the body runs. kpool_ref/vpool_ref are the ALIASED output
+    refs of the full pools (ANY/HBM memory space); kpool_in/vpool_in
+    are the same buffers' input refs and are intentionally unused.
+    kbuf/vbuf are [2, block_size, heads, D] VMEM double buffers."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = pl.program_id(0)
+    pos = pos_ref[s]
+    last_blk = pos // block_size
+    nblk = last_blk + 1
+
+    # fused KV write: this token's row lands in the pool before the
+    # LAST block of this slot's walk is streamed (that block reads it
+    # back); earlier blocks don't depend on it, so their copies run
+    # concurrently with the write instead of behind a write round-trip
+    wk = pltpu.make_async_copy(
+        knew_ref.at[0],
+        kpool_ref.at[layer, bt_ref[s, last_blk], pos % block_size],
+        write_sems.at[0])
+    wv = pltpu.make_async_copy(
+        vnew_ref.at[0],
+        vpool_ref.at[layer, bt_ref[s, last_blk], pos % block_size],
+        write_sems.at[1])
+    wk.start()
+    wv.start()
+
+    def kv_copies(j, buf):
+        bid = bt_ref[s, j]
+        return (pltpu.make_async_copy(kpool_ref.at[layer, bid],
+                                      kbuf.at[buf], copy_sems.at[0, buf]),
+                pltpu.make_async_copy(vpool_ref.at[layer, bid],
+                                      vbuf.at[buf], copy_sems.at[1, buf]))
+
+    def start_copies(j, buf):
+        ck, cv = kv_copies(j, buf)
+        ck.start()
+        cv.start()
+
+    @pl.when(last_blk == 0)
+    def _first_is_last():           # 1-block walk: copy needs the write
+        wk.wait()
+        wv.wait()
+        start_copies(0, 0)
+
+    @pl.when(last_blk > 0)
+    def _first():                   # block 0 is write-independent
+        start_copies(0, 0)
+
+    # inputs stay at the pool dtype through the matmuls (bf16 MXU
+    # passes on TPU); accumulation is forced fp32 by
+    # preferred_element_type — same numerics policy as the dense path
+    q = q_ref[0].astype(kbuf.dtype)             # [heads, D]
+    heads, head_dim = q.shape
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            @pl.when(j + 1 == last_blk)
+            def _writes_land_first():   # exactly once per program
+                wk.wait()
+                wv.wait()
+
+            start_copies(j + 1, (j + 1) % 2)
+
+        ck, cv = kv_copies(j, j % 2)
+        ck.wait()
+        cv.wait()
+        k = kbuf[j % 2]                         # [bs, heads, D]
+        v = vbuf[j % 2]
+        sc = jnp.einsum("hd,khd->hk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+        gpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (heads, block_size), 1)
+        sc = jnp.where(gpos <= pos, sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)                 # [heads, bs] fp32
+        alpha = jnp.exp(m - m_new)              # [heads, 1]
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "hk,khd->hd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((heads, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((heads, 1), jnp.float32)
+    acc0 = jnp.zeros((heads, head_dim), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, knew, vnew, kpool, vpool, layer,
+                           block_tables, positions, scale=None,
+                           interpret: bool = False):
+    """Fused paged decode attention over the global pool, one layer.
+
+    q/knew/vnew: `[slots, 1, heads, head_dim]` — this step's
+    projections. kpool/vpool: `[layers, num_blocks, block_size, heads,
+    head_dim]`. layer: python int (static). block_tables
+    `[slots, max_blocks]` int32; positions `[slots]` int32.
+
+    Returns `(out [slots, 1, heads, head_dim], new_kpool, new_vpool)`
+    with the pools updated in place when XLA can alias them (the
+    engine's donated decode step) — same contract as the dense
+    `paged_attention_step` fallback.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    slots, one, heads, head_dim = q.shape
+    assert one == 1, "decode kernel takes one token per slot"
+    num_layers, num_blocks, block_size, _, _ = kpool.shape
+    if scale is None:
+        scale = 1.0 / (head_dim ** 0.5)
+
+    q3 = q.reshape(slots, heads, head_dim)
+    k3 = knew.reshape(slots, heads, head_dim).astype(kpool.dtype)
+    v3 = vnew.reshape(slots, heads, head_dim).astype(vpool.dtype)
+
+    kernel = functools.partial(_decode_kernel, layer=int(layer),
+                               block_size=block_size, scale=scale)
+    row = lambda s, *_: (s, 0, 0)  # noqa: E731 — per-slot [1,heads,D]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,     # block_tables, positions
+        grid=(slots,),
+        in_specs=[
+            pl.BlockSpec((1, heads, head_dim), row),
+            pl.BlockSpec((1, heads, head_dim), row),
+            pl.BlockSpec((1, heads, head_dim), row),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, heads, head_dim), row),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, heads, head_dim), kpool.dtype),
+            pltpu.VMEM((2, block_size, heads, head_dim), vpool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),   # [k|v, buffer]
+            pltpu.SemaphoreType.DMA((2,)),     # [k|v] fused write
+        ],
+    )
+    out, new_kpool, new_vpool = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, heads, head_dim), q.dtype),
+            jax.ShapeDtypeStruct(kpool.shape, kpool.dtype),
+            jax.ShapeDtypeStruct(vpool.shape, vpool.dtype),
+        ],
+        # flat input order: bt, pos, q, knew, vnew, kpool, vpool — the
+        # pools alias outputs 1/2 so the fused write mutates in place
+        input_output_aliases={5: 1, 6: 2},
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
+      q3, k3, v3, kpool, vpool)
+    return out.reshape(slots, 1, heads, head_dim), new_kpool, new_vpool

@@ -260,10 +260,12 @@ def test_count_traces_probe_and_expect_traces():
             pass
 
 
-def test_engine_offered_load_bench_runner_tiny():
+def test_engine_offered_load_bench_runner_tiny(monkeypatch):
     """The OPBENCH engine row's runner, at test scale: mixed
     prompt/output lengths through the engine, aggregate tokens/s out
     (the TPU run uses the representative 350M defaults)."""
+    # isolate from the deploy knob: the default row must resolve auto
+    monkeypatch.delenv("PADDLE_PAGED_ATTENTION_BACKEND", raising=False)
     import bench_ops
 
     model_cfg = GPTConfig.tiny(vocab=32, hidden=16, layers=1, heads=2,
@@ -275,6 +277,18 @@ def test_engine_offered_load_bench_runner_tiny():
         num_slots=2, block_size=4, prefill_buckets=(4, 8, 16, 32))()
     assert rec["requests"] == 3
     assert rec["tokens_per_s"] > 0 and rec["ms"] > 0
+    assert rec["attention_backend"] == "dense"     # auto off-TPU
+    # the pallas variant row runs the same trace on the fused kernel
+    # (interpreted off-TPU) and must serve every request too; ONE
+    # request/bucket — interpret-mode compiles dominate, and the
+    # backend itself is parity-tested in test_paged_attention_backends
+    paddle.seed(0)
+    rec_p = bench_ops._engine_offered_load_case(
+        model_cfg=model_cfg, requests=[(3, 3)],
+        num_slots=1, block_size=4, prefill_buckets=(4, 32),
+        attention_backend="pallas")()
+    assert rec_p["attention_backend"] == "pallas"
+    assert rec_p["requests"] == 1 and rec_p["tokens_per_s"] > 0
     # names the gate will track are emitted by the suite
     s = bench_ops.suite()
     assert "gpt_decode_kv_350m" in s and callable(s["gpt_decode_kv_350m"])

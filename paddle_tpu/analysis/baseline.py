@@ -1,0 +1,60 @@
+"""Committed-baseline support: grandfathered findings.
+
+The baseline is a JSON file of entries ``{"id", "rule", "path",
+"justification"}``. A finding whose stable ID appears in the baseline
+does not fail the gate — but every entry MUST carry a non-empty
+human-written justification (an empty one is a loader error: silent
+grandfathering is how gates rot). Stale entries (no current finding
+matches) are reported as notes so fixed hazards get un-baselined.
+"""
+from __future__ import annotations
+
+import json
+
+
+class BaselineError(ValueError):
+    pass
+
+
+def load_baseline(path):
+    """-> {finding_id: entry}. Raises BaselineError on malformed or
+    unjustified entries."""
+    with open(path) as f:
+        data = json.load(f)
+    entries = data if isinstance(data, list) else data.get("entries", [])
+    out = {}
+    for n, e in enumerate(entries):
+        if not isinstance(e, dict) or "id" not in e:
+            raise BaselineError(f"baseline entry #{n} has no 'id'")
+        if not str(e.get("justification", "")).strip():
+            raise BaselineError(
+                f"baseline entry {e['id']} has no justification — "
+                "every grandfathered finding needs a written reason "
+                "(or a fix)")
+        out[e["id"]] = e
+    return out
+
+
+def apply_baseline(findings, baseline):
+    """Mark findings present in the baseline; -> list of stale baseline
+    ids (entries no current finding matches)."""
+    live = set()
+    for f in findings:
+        if f.id in baseline:
+            f.baselined = True
+            live.add(f.id)
+    return sorted(set(baseline) - live)
+
+
+def write_baseline(path, findings):
+    """Write the given (new, unsuppressed) findings as a baseline
+    skeleton. Justifications are intentionally EMPTY — the loader
+    rejects them until a human writes one per entry."""
+    entries = [{
+        "id": f.id, "rule": f.rule, "path": f.path, "line": f.line,
+        "message": f.message, "justification": "",
+    } for f in findings]
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=1)
+        fh.write("\n")
+    return len(entries)

@@ -1,0 +1,674 @@
+"""tpu-lint analysis engine: per-module AST model.
+
+Three layers feed the rules:
+
+1. **Alias resolution** — every `import`/`from ... import` binds a
+   local name to a canonical dotted path, so `jnp.matmul`,
+   `jax.numpy.matmul` and `from jax.numpy import matmul` all resolve
+   to ``jax.numpy.matmul`` before any registry lookup
+   (`paddle_tpu.jit.introspect` holds the registries — the jit
+   layer's own metadata, not string patterns in the analyzer).
+
+2. **Traced-ness fixpoint** — a function is traced if it is (a)
+   decorated by a trace entry (`@jax.jit`, `@to_static`,
+   `@partial(jax.jit, ...)`), (b) passed at a traced-callable
+   position of a tracing API (`jax.jit(f)`, `lax.scan(body, ...)`,
+   `pallas_call(kernel, ...)`), (c) RETURNED by a local builder whose
+   result is staged (`jax.jit(self._make_step_fn())` marks the
+   nested ``step_fn`` that the builder chain returns), or (d) called
+   from a traced function — including calls through a local variable
+   bound to a builder's result (``forward_loss = make_forward_loss(...)``
+   then ``forward_loss(...)`` inside a traced body). All resolution is
+   name-based and module-local; the false-negative boundary is
+   documented in DESIGN_DECISIONS.
+
+3. **Taint** — inside a traced function, which expressions derive
+   from traced operands: parameters seed the taint set (minus
+   `self`/`cls`, minus params at `static_argnums`/`static_argnames`
+   of the staging call, minus params with python-constant defaults —
+   those are near-always intended static) and taint propagates
+   through arithmetic, `jnp.*`/`jax.*` results, subscripts and
+   assignments. Shape/dtype/ndim reads, `len()`, identity
+   comparisons and `isinstance` are concrete under trace and
+   untaint.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from paddle_tpu.jit import introspect as I
+
+from .findings import Finding
+
+UNTAINT_ATTRS = {"shape", "dtype", "ndim", "size", "name", "sharding",
+                 "weak_type"}
+UNTAINT_CALLS = {"len", "isinstance", "hasattr", "callable", "type",
+                 "id", "range", "repr", "str", "format", "getattr"}
+
+
+@dataclass
+class FuncInfo:
+    node: object
+    name: str
+    qualname: str
+    parent: "FuncInfo | None"
+    class_name: str | None = None
+    is_lambda: bool = False
+    params: list = field(default_factory=list)
+    param_defaults: dict = field(default_factory=dict)  # name -> has const default
+    static_params: set = field(default_factory=set)
+    traced: bool = False
+    trace_via: str | None = None
+    dy2static: bool = False
+    not_traced: bool = False
+    has_bf16: bool = False
+    children: dict = field(default_factory=dict)   # simple name -> FuncInfo
+    lambdas: list = field(default_factory=list)
+    nodes: list = field(default_factory=list)      # ast nodes owned directly
+    returns: list = field(default_factory=list)    # owned Return.value exprs
+    local_bindings: set = field(default_factory=set)
+    assigns_from_calls: dict = field(default_factory=dict)  # name -> Call
+    global_names: set = field(default_factory=set)
+    taint: set | None = None
+
+    def effective_bf16(self):
+        fi = self
+        while fi is not None:
+            if fi.has_bf16:
+                return True
+            fi = fi.parent
+        return False
+
+    def lookup(self, name):
+        """Resolve a simple name to a FuncInfo through the scope chain."""
+        fi = self
+        while fi is not None:
+            if name in fi.children:
+                return fi.children[name]
+            fi = fi.parent
+        return None
+
+    def lookup_assigned_call(self, name):
+        fi = self
+        while fi is not None:
+            if name in fi.assigns_from_calls:
+                return fi.assigns_from_calls[name], fi
+            fi = fi.parent
+        return None, None
+
+
+class ModuleAnalysis:
+    def __init__(self, path, src, module_name=None):
+        self.path = path
+        self.src = src
+        self.module_name = module_name or ""
+        self.tree = ast.parse(src, filename=path)
+        self.aliases = {}
+        self.module_fn = FuncInfo(node=self.tree, name="<module>",
+                                  qualname="<module>", parent=None)
+        self.functions = [self.module_fn]   # all FuncInfos incl lambdas
+        self._by_simple_name = {}
+        self._collect_imports()
+        self._build_function_table()
+        self._compute_pure_predicates()
+        self._resolve_tracedness()
+
+    # -- alias / name resolution -------------------------------------------
+
+    def _collect_imports(self):
+        pkg_parts = self.module_name.split(".")[:-1] if self.module_name \
+            else []
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.aliases.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base_parts = pkg_parts[:len(pkg_parts)
+                                           - (node.level - 1)]
+                    base = ".".join(base_parts)
+                    mod = f"{base}.{node.module}" if node.module else base
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = f"{mod}.{a.name}" if mod else a.name
+                    self.aliases[a.asname or a.name] = target
+
+    def resolve(self, node):
+        """Canonical dotted name of an expression, or None."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return f"{base}.{node.attr}" if base else None
+        return None
+
+    # -- function table ----------------------------------------------------
+
+    def _build_function_table(self):
+        mod = self
+
+        class Builder(ast.NodeVisitor):
+            def __init__(self):
+                self.owner = mod.module_fn
+                self.class_stack = []
+
+            def _register(self, fi):
+                mod.functions.append(fi)
+                mod._by_simple_name.setdefault(fi.name, []).append(fi)
+
+            def _func(self, node, name, is_lambda=False):
+                parent = self.owner
+                qual = name if parent is mod.module_fn \
+                    else f"{parent.qualname}.{name}"
+                if self.class_stack and parent is mod.module_fn:
+                    qual = f"{'.'.join(self.class_stack)}.{name}"
+                fi = FuncInfo(node=node, name=name, qualname=qual,
+                              parent=parent, is_lambda=is_lambda,
+                              class_name=self.class_stack[-1]
+                              if self.class_stack else None)
+                args = node.args
+                all_args = (list(getattr(args, "posonlyargs", []))
+                            + list(args.args) + list(args.kwonlyargs))
+                fi.params = [a.arg for a in all_args]
+                if args.vararg:
+                    fi.params.append(args.vararg.arg)
+                if args.kwarg:
+                    fi.params.append(args.kwarg.arg)
+                defaults = list(args.defaults)
+                for a, d in zip(reversed(args.args), reversed(defaults)):
+                    fi.param_defaults[a.arg] = isinstance(d, ast.Constant)
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    if d is not None:
+                        fi.param_defaults[a.arg] = isinstance(d, ast.Constant)
+                fi.local_bindings = set(fi.params)
+                self._register(fi)
+                if is_lambda:
+                    parent.lambdas.append(fi)
+                else:
+                    parent.children[name] = fi
+                return fi
+
+            def visit_ClassDef(self, node):
+                node._tl_owner = self.owner
+                self.owner.nodes.append(node)
+                self.class_stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    self.visit(child)
+                self.class_stack.pop()
+
+            def _visit_func(self, node, fi):
+                prev, self.owner = self.owner, fi
+                prev_cls, self.class_stack = self.class_stack, []
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for child in body:
+                    self.visit(child)
+                # decorators/defaults are evaluated in the ENCLOSING scope
+                self.owner, self.class_stack = prev, prev_cls
+                for d in getattr(node, "decorator_list", []):
+                    self.visit(d)
+                for d in (node.args.defaults
+                          + [x for x in node.args.kw_defaults if x]):
+                    self.visit(d)
+
+            def visit_FunctionDef(self, node):
+                node._tl_owner = self.owner
+                self.owner.nodes.append(node)
+                fi = self._func(node, node.name)
+                self._visit_func(node, fi)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                node._tl_owner = self.owner
+                # occurrence index, NOT lineno: finding IDs hash the
+                # qualname and must survive line shifts
+                fi = self._func(node,
+                                f"<lambda#{len(self.owner.lambdas)}>",
+                                is_lambda=True)
+                node._tl_func = fi
+                self._visit_func(node, fi)
+
+            def generic_visit(self, node):
+                node._tl_owner = self.owner
+                self.owner.nodes.append(node)
+                super().generic_visit(node)
+
+        b = Builder()
+        for child in ast.iter_child_nodes(self.tree):
+            b.visit(child)
+
+        # per-owner bookkeeping: bindings, returns, builder assigns, bf16
+        self._self_attr_assigns = {}
+        for fi in self.functions:
+            for node in fi.nodes:
+                if isinstance(node, ast.Return) and node.value is not None:
+                    fi.returns.append(node.value)
+                elif isinstance(node, ast.Global):
+                    fi.global_names.update(node.names)
+                elif isinstance(node, (ast.Assign, ast.AnnAssign,
+                                       ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for n in self._target_names(t):
+                            fi.local_bindings.add(n)
+                    value = getattr(node, "value", None)
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(value, ast.Call) and \
+                            len(node.targets) == 1 and \
+                            isinstance(node.targets[0], ast.Name):
+                        fi.assigns_from_calls[node.targets[0].id] = value
+                    if isinstance(node, ast.Assign) and value is not None:
+                        for t in node.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id in ("self", "cls"):
+                                self._self_attr_assigns.setdefault(
+                                    t.attr, []).append((value, fi))
+                elif isinstance(node, ast.For):
+                    for n in self._target_names(node.target):
+                        fi.local_bindings.add(n)
+                elif isinstance(node, ast.With):
+                    for item in node.items:
+                        if item.optional_vars is not None:
+                            for n in self._target_names(item.optional_vars):
+                                fi.local_bindings.add(n)
+                elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                    for a in node.names:
+                        fi.local_bindings.add(
+                            (a.asname or a.name).split(".")[0])
+                elif isinstance(node, ast.comprehension):
+                    for n in self._target_names(node.target):
+                        fi.local_bindings.add(n)
+                elif isinstance(node, ast.ExceptHandler) and node.name:
+                    fi.local_bindings.add(node.name)
+                if isinstance(node, ast.Attribute) and \
+                        node.attr == "bfloat16":
+                    fi.has_bf16 = True
+                elif isinstance(node, ast.Constant) and \
+                        node.value == "bfloat16":
+                    fi.has_bf16 = True
+
+    @staticmethod
+    def _target_names(target):
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = []
+            for e in target.elts:
+                out.extend(ModuleAnalysis._target_names(e))
+            return out
+        if isinstance(target, ast.Starred):
+            return ModuleAnalysis._target_names(target.value)
+        return []
+
+    # -- pure predicates ---------------------------------------------------
+
+    _PREDICATE_CALLS = {"isinstance", "issubclass", "hasattr", "callable",
+                        "type", "len", "getattr"}
+
+    def _compute_pure_predicates(self):
+        """Simple names of local functions whose entire body is one
+        `return <structure test>` — isinstance/hasattr chains over
+        their arguments. Such calls answer python-level questions and
+        never depend on a tracer's VALUE, so they untaint."""
+
+        def pure(e):
+            if isinstance(e, (ast.Name, ast.Constant, ast.Attribute)):
+                return True
+            if isinstance(e, ast.Tuple):
+                return all(pure(x) for x in e.elts)
+            if isinstance(e, ast.BoolOp):
+                return all(pure(v) for v in e.values)
+            if isinstance(e, ast.UnaryOp):
+                return pure(e.operand)
+            if isinstance(e, ast.Compare):
+                return all(isinstance(op, (ast.Is, ast.IsNot))
+                           for op in e.ops) and pure(e.left) and \
+                    all(pure(c) for c in e.comparators)
+            if isinstance(e, ast.Call):
+                return self.resolve(e.func) in self._PREDICATE_CALLS \
+                    and all(pure(a) for a in e.args)
+            return False
+
+        self.pure_predicates = set()
+        for fi in self.functions:
+            if fi.is_lambda or fi.node is self.tree:
+                continue
+            body = [s for s in fi.node.body
+                    if not (isinstance(s, ast.Expr)
+                            and isinstance(s.value, ast.Constant))]
+            if len(body) == 1 and isinstance(body[0], ast.Return) and \
+                    body[0].value is not None and pure(body[0].value):
+                self.pure_predicates.add(fi.name)
+
+    # -- traced-ness -------------------------------------------------------
+
+    def _jit_kwargs(self, call):
+        """(static_param_positions, static_param_names) from a jit-like
+        call's keywords — constant values only."""
+        positions, names = set(), set()
+        for kw in call.keywords:
+            if kw.arg in I.STATIC_ARG_KEYWORDS:
+                val = kw.value
+                consts = []
+                if isinstance(val, ast.Constant):
+                    consts = [val.value]
+                elif isinstance(val, (ast.Tuple, ast.List)):
+                    consts = [e.value for e in val.elts
+                              if isinstance(e, ast.Constant)]
+                for c in consts:
+                    if isinstance(c, int) and not isinstance(c, bool):
+                        positions.add(c)
+                    elif isinstance(c, str):
+                        names.add(c)
+        return positions, names
+
+    def _mark_traced(self, fi, via, static_info=None):
+        if fi is None or fi.traced or fi.not_traced:
+            return
+        fi.traced = True
+        fi.trace_via = via
+        fi.dy2static = I.TRACE_DECORATORS.get(via) == "dy2static"
+        if static_info:
+            positions, names = static_info
+            offset = 1 if fi.params and fi.params[0] in ("self", "cls") \
+                else 0
+            for p in positions:
+                idx = p + offset
+                if 0 <= idx < len(fi.params):
+                    fi.static_params.add(fi.params[idx])
+            fi.static_params.update(n for n in names if n in fi.params)
+        self._worklist.append(fi)
+
+    def _stage_expr(self, expr, owner, via, static_info, depth=0,
+                    visited=None):
+        """An expression is being staged as a traced callable: resolve
+        it to local FuncInfos (through builder returns, one module)."""
+        if depth > 6:
+            return
+        visited = visited if visited is not None else set()
+        if isinstance(expr, ast.Name):
+            fi = owner.lookup(expr.id)
+            if fi is not None:
+                self._mark_traced(fi, via, static_info)
+                return
+            built, _scope = owner.lookup_assigned_call(expr.id)
+            if built is not None and id(built) not in visited:
+                # f = builder(...) ; jax.jit(f)
+                visited.add(id(built))
+                self._stage_expr(built, getattr(built, "_tl_owner",
+                                                owner),
+                                 via, static_info, depth + 1, visited)
+        elif isinstance(expr, ast.Lambda):
+            self._mark_traced(getattr(expr, "_tl_func", None), via,
+                              static_info)
+        elif isinstance(expr, (ast.Tuple, ast.List)):
+            for e in expr.elts:
+                self._stage_expr(e, owner, via, static_info, depth + 1,
+                                 visited)
+        elif isinstance(expr, ast.Attribute):
+            # jax.jit(self._decode_pure): stage every rhs ever assigned
+            # to that instance attribute in this module
+            for rhs, rhs_owner in self._self_attr_assigns.get(
+                    expr.attr, []):
+                if id(rhs) not in visited:
+                    visited.add(id(rhs))
+                    self._stage_expr(rhs, rhs_owner, via, static_info,
+                                     depth + 1, visited)
+        elif isinstance(expr, ast.Call):
+            fname = self.resolve(expr.func)
+            if fname in I.PASSTHROUGH_WRAPPERS:
+                # count_traces(f) / partial(f, ...): trace semantics
+                # pass through to the first argument. Keywords bound by
+                # partial are python constants at trace-build time —
+                # static params of the staged function.
+                if expr.args:
+                    if fname in ("functools.partial", "partial"):
+                        bound = {kw.arg for kw in expr.keywords if kw.arg}
+                        positions, names = static_info or (set(), set())
+                        static_info = (set(positions),
+                                       set(names) | bound)
+                    self._stage_expr(expr.args[0], owner, via,
+                                     static_info, depth + 1, visited)
+                return
+            builders = []
+            f = expr.func
+            if isinstance(f, ast.Name):
+                b = owner.lookup(f.id)
+                if b is not None:
+                    builders = [b]
+            elif isinstance(f, ast.Attribute):
+                # self._make_step_fn() — resolve the method by simple
+                # name anywhere in the module (class-local preferred)
+                cands = self._by_simple_name.get(f.attr, [])
+                builders = [c for c in cands if c.class_name] or cands
+            for b in builders:
+                if id(b) in visited:
+                    continue
+                visited.add(id(b))
+                for ret in b.returns:
+                    self._stage_expr(ret, b, via, static_info, depth + 1,
+                                     visited)
+
+    def _resolve_tracedness(self):
+        self._worklist = []
+        # pass A: decorators
+        for fi in self.functions:
+            for dec in getattr(fi.node, "decorator_list", []):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = self.resolve(target)
+                if name in I.NOT_TRACED_DECORATORS:
+                    fi.not_traced = True
+                    continue
+                static_info = self._jit_kwargs(dec) \
+                    if isinstance(dec, ast.Call) else None
+                if name in I.TRACE_DECORATORS:
+                    self._mark_traced(fi, name, static_info)
+                elif name in ("functools.partial", "partial") and \
+                        isinstance(dec, ast.Call) and dec.args:
+                    inner = self.resolve(dec.args[0])
+                    if inner in I.TRACE_DECORATORS:
+                        self._mark_traced(fi, inner, static_info)
+
+        # pass B: call-site staging
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = self.resolve(node.func)
+            positions = None
+            if fname in I.TRACING_CALLABLES:
+                positions = I.TRACING_CALLABLES[fname]
+            elif fname in ("functools.partial", "partial") and node.args:
+                inner = self.resolve(node.args[0])
+                if inner in I.TRACING_CALLABLES:
+                    # partial(jax.jit, static_argnums=...)(f) is rare;
+                    # partial(fn) staged later by the outer call is the
+                    # common shape — nothing to do here.
+                    continue
+            if positions is None:
+                continue
+            owner = getattr(node, "_tl_owner", self.module_fn)
+            static_info = self._jit_kwargs(node) \
+                if fname in I.JIT_LIKE else None
+            for pos in positions:
+                if pos < len(node.args):
+                    self._stage_expr(node.args[pos], owner, fname,
+                                     static_info)
+
+        # pass C: propagation — callees of traced functions are traced
+        while self._worklist:
+            fi = self._worklist.pop()
+            for child in list(fi.children.values()) + fi.lambdas:
+                self._mark_traced(child, f"nested:{fi.qualname}")
+            for node in fi.nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Name):
+                    callee = fi.lookup(f.id)
+                    if callee is not None:
+                        self._mark_traced(
+                            callee, f"called-from:{fi.qualname}")
+                        continue
+                    built, _scope = fi.lookup_assigned_call(f.id)
+                    if built is not None:
+                        # forward_loss = make_forward_loss(...) then
+                        # forward_loss(...) under trace: the builder's
+                        # returned functions run traced
+                        self._stage_expr(
+                            built, getattr(built, "_tl_owner", fi),
+                            f"called-from:{fi.qualname}", None)
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id in ("self", "cls") and fi.class_name:
+                    for cand in self._by_simple_name.get(f.attr, []):
+                        if cand.class_name == fi.class_name:
+                            self._mark_traced(
+                                cand, f"called-from:{fi.qualname}")
+
+    # -- taint -------------------------------------------------------------
+
+    def func_taint(self, fi):
+        """Names holding traced values inside a traced function
+        (memoized; parents computed first so closures inherit)."""
+        if fi.taint is not None:
+            return fi.taint
+        seed = set()
+        if fi.traced:
+            for p in fi.params:
+                if p in ("self", "cls") or p in fi.static_params:
+                    continue
+                if fi.param_defaults.get(p):
+                    continue  # constant-default params: near-always static
+                seed.add(p)
+        if fi.parent is not None and fi.parent.traced:
+            # closures: names tainted in the enclosing traced scope stay
+            # tainted here unless locally rebound (params shadow too)
+            parent_taint = self.func_taint(fi.parent)
+            seed |= {n for n in parent_taint
+                     if n not in fi.local_bindings}
+        fi.taint = seed
+        # two forward passes: taint only grows, and the second pass
+        # stabilizes loop-carried assignments
+        for _ in range(2):
+            for node in fi.nodes:
+                self._taint_stmt(node, fi)
+        return fi.taint
+
+    def _taint_stmt(self, node, fi):
+        if isinstance(node, ast.Assign):
+            t = self.expr_taint(node.value, fi)
+            if t:
+                for tgt in node.targets:
+                    fi.taint.update(self._target_names(tgt))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if self.expr_taint(node.value, fi):
+                fi.taint.update(self._target_names(node.target))
+        elif isinstance(node, ast.AugAssign):
+            if self.expr_taint(node.value, fi) or \
+                    self.expr_taint(node.target, fi):
+                fi.taint.update(self._target_names(node.target))
+        elif isinstance(node, ast.For):
+            if self.expr_taint(node.iter, fi):
+                fi.taint.update(self._target_names(node.target))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None and \
+                        self.expr_taint(item.context_expr, fi):
+                    fi.taint.update(
+                        self._target_names(item.optional_vars))
+        elif isinstance(node, ast.comprehension):
+            if self.expr_taint(node.iter, fi):
+                fi.taint.update(self._target_names(node.target))
+
+    def expr_taint(self, e, fi):
+        """Whether an expression may hold a traced value."""
+        taint = fi.taint if fi.taint is not None else set()
+        if isinstance(e, ast.Name):
+            return e.id in taint
+        if isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Attribute):
+            if e.attr in UNTAINT_ATTRS:
+                return False
+            return self.expr_taint(e.value, fi)
+        if isinstance(e, ast.Call):
+            fname = self.resolve(e.func)
+            if fname in UNTAINT_CALLS:
+                return False
+            # local isinstance-style predicates (`_is_arraylike(x)`,
+            # `_is_traced(x)`) answer python-structure questions, never
+            # tracer values — calls to them are concrete under trace
+            if isinstance(e.func, ast.Name) and \
+                    e.func.id in self.pure_predicates:
+                return False
+            # NOTE: no blanket "jnp call => tainted": inside a trace,
+            # jnp.zeros(...) etc. are constants; only values derived
+            # from traced INPUTS are tracers, which argument
+            # propagation below captures.
+            if isinstance(e.func, ast.Attribute):
+                if self.expr_taint(e.func.value, fi):
+                    return True
+            return any(self.expr_taint(a, fi) for a in e.args) or \
+                any(self.expr_taint(kw.value, fi) for kw in e.keywords)
+        if isinstance(e, ast.BinOp):
+            return self.expr_taint(e.left, fi) or \
+                self.expr_taint(e.right, fi)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr_taint(e.operand, fi)
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr_taint(v, fi) for v in e.values)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in e.ops):
+                return False
+            return self.expr_taint(e.left, fi) or \
+                any(self.expr_taint(c, fi) for c in e.comparators)
+        if isinstance(e, ast.Subscript):
+            return self.expr_taint(e.value, fi)
+        if isinstance(e, ast.IfExp):
+            return any(self.expr_taint(x, fi)
+                       for x in (e.test, e.body, e.orelse))
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_taint(x, fi) for x in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(self.expr_taint(v, fi)
+                       for v in e.values if v is not None)
+        if isinstance(e, ast.JoinedStr):
+            return any(self.expr_taint(v.value, fi)
+                       for v in e.values
+                       if isinstance(v, ast.FormattedValue))
+        if isinstance(e, ast.Starred):
+            return self.expr_taint(e.value, fi)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self.expr_taint(g.iter, fi) for g in e.generators)
+        if isinstance(e, ast.DictComp):
+            return any(self.expr_taint(g.iter, fi) for g in e.generators)
+        return False
+
+    # -- helpers for rules ---------------------------------------------------
+
+    def finding(self, rule, node, message, fi=None):
+        line = getattr(node, "lineno", 1)
+        src_line = ""
+        lines = self.src.splitlines()
+        if 1 <= line <= len(lines):
+            src_line = lines[line - 1].strip()
+        return Finding(rule=rule, path=self.path, line=line,
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       qualname=(fi or self.module_fn).qualname,
+                       source=src_line)
+
+    def traced_functions(self):
+        return [fi for fi in self.functions if fi.traced]

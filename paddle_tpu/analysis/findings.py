@@ -1,0 +1,78 @@
+"""Finding model + stable IDs + inline suppressions for tpu-lint.
+
+A finding's ID is deliberately LINE-NUMBER-FREE: it hashes
+(rule, path, enclosing qualname, normalized source line text,
+occurrence index), so a baseline entry survives unrelated edits that
+shift the file, but changing the flagged line itself (i.e. touching
+the hazard) invalidates the grandfathering and re-surfaces it.
+"""
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass, field
+
+# `# tpu-lint: disable=TPU001` or `disable=TPU001,TPU005` — suppresses
+# those rules on the SAME physical line.
+_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # posix-style, repo-relative when possible
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    qualname: str = "<module>"
+    source: str = ""   # stripped text of the flagged line
+    id: str = field(default="", compare=False)
+    suppressed: bool = field(default=False, compare=False)
+    baselined: bool = field(default=False, compare=False)
+
+    def location(self):
+        return f"{self.path}:{self.line}:{self.col + 1}"
+
+    def render(self):
+        return f"{self.location()}: {self.rule} {self.message} [{self.id}]"
+
+    def to_dict(self):
+        return {
+            "id": self.id, "rule": self.rule, "path": self.path,
+            "line": self.line, "col": self.col, "message": self.message,
+            "qualname": self.qualname, "source": self.source,
+        }
+
+
+def assign_ids(findings):
+    """Stable IDs: hash of line-free identity, disambiguated by
+    occurrence order among identical tuples (two identical hazards on
+    identical lines in one function get index 0 and 1)."""
+    seen = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        key = (f.rule, f.path, f.qualname, f.source)
+        idx = seen.get(key, 0)
+        seen[key] = idx + 1
+        digest = hashlib.sha1(
+            "|".join([f.rule, f.path, f.qualname, f.source,
+                      str(idx)]).encode()).hexdigest()[:10]
+        f.id = f"{f.rule}:{digest}"
+    return findings
+
+
+def parse_suppressions(src):
+    """line (1-based) -> set of rule names suppressed on that line."""
+    out = {}
+    for n, text in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            out[n] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def apply_suppressions(findings, suppressions):
+    for f in findings:
+        rules = suppressions.get(f.line)
+        if rules and (f.rule in rules or "ALL" in rules):
+            f.suppressed = True
+    return findings

@@ -1,0 +1,715 @@
+"""The tpu-lint rule set (TPU001..TPU008).
+
+Each rule is a function Module -> [Finding]. Registries of names
+(trace entries, collectives, samplers, contraction ops) come from
+`paddle_tpu.jit.introspect` — the jit layer's own metadata.
+
+TPU003/TPU004 run a small branch-aware linear interpreter over each
+function body: `if`/`else` branches execute on copies of the state and
+merge (branches that terminate in return/raise don't merge back), loop
+bodies execute twice so loop-carried hazards (a key consumed on
+iteration 1 and again on iteration 2, a buffer donated then read at
+the top of the next iteration) surface, with findings deduplicated by
+position.
+"""
+from __future__ import annotations
+
+import ast
+
+from paddle_tpu.jit import introspect as I
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "setdefault",
+             "remove", "discard", "clear", "pop", "popitem", "appendleft"}
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+
+
+def _owned_calls(fi):
+    return [n for n in fi.nodes if isinstance(n, ast.Call)]
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — host sync inside traced code
+# ---------------------------------------------------------------------------
+
+def rule_tpu001(m):
+    out = []
+    for fi in m.traced_functions():
+        m.func_taint(fi)
+        for node in _owned_calls(fi):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in I.HOST_SYNC_METHODS and \
+                    m.expr_taint(f.value, fi):
+                out.append(m.finding(
+                    "TPU001", node,
+                    f"`.{f.attr}()` on a traced value forces a "
+                    "device->host sync inside traced code (blocks "
+                    "dispatch or fails to trace); keep the value on "
+                    "device or move the sync outside the jitted fn",
+                    fi))
+                continue
+            name = m.resolve(f)
+            if name in I.HOST_SYNC_CALLS and any(
+                    m.expr_taint(a, fi) for a in node.args):
+                out.append(m.finding(
+                    "TPU001", node,
+                    f"`{name}` concretizes a traced value on host "
+                    "inside traced code; use jnp ops instead", fi))
+            elif name in I.HOST_SYNC_BUILTINS and node.args and \
+                    m.expr_taint(node.args[0], fi):
+                out.append(m.finding(
+                    "TPU001", node,
+                    f"`{name}()` of a traced value raises "
+                    "ConcretizationError under jit; keep it as a "
+                    "0-d array (or hoist the scalar out of the "
+                    "traced fn)", fi))
+            elif name == "print" and any(
+                    m.expr_taint(a, fi) for a in node.args):
+                out.append(m.finding(
+                    "TPU001", node,
+                    "`print` of a traced value runs once at trace "
+                    "time (and syncs if it concretizes); use "
+                    "jax.debug.print", fi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — python control flow on traced booleans
+# ---------------------------------------------------------------------------
+
+def rule_tpu002(m):
+    out = []
+    for fi in m.traced_functions():
+        if fi.dy2static:
+            # to_static runs the dy2static AST pass: tracer if/while
+            # become lax.cond/while_loop in the wrapped fn itself
+            continue
+        m.func_taint(fi)
+        for node in fi.nodes:
+            if isinstance(node, (ast.If, ast.While)) and \
+                    m.expr_taint(node.test, fi):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                fix = "lax.cond/jnp.where" if kind == "if" \
+                    else "lax.while_loop"
+                out.append(m.finding(
+                    "TPU002", node,
+                    f"python `{kind}` on a traced value retraces per "
+                    "value or raises ConcretizationError; use "
+                    f"{fix} (or mark the arg static)", fi))
+            elif isinstance(node, ast.Assert) and \
+                    m.expr_taint(node.test, fi):
+                out.append(m.finding(
+                    "TPU002", node,
+                    "`assert` on a traced value concretizes under "
+                    "jit; use checkify or debug.check, or assert "
+                    "outside the traced fn", fi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# linear branch-aware walkers (TPU003 / TPU004)
+# ---------------------------------------------------------------------------
+
+class _LinearRule:
+    """Executes a function body statement-by-statement with a dict
+    state; If branches fork+merge, loop bodies run twice."""
+
+    def __init__(self, module, fi):
+        self.m = module
+        self.fi = fi
+        self.findings = []
+        self._reported = set()
+
+    def report(self, rule, node, message):
+        key = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0),
+               rule)
+        if key not in self._reported:
+            self._reported.add(key)
+            self.findings.append(
+                self.m.finding(rule, node, message, self.fi))
+
+    def run(self):
+        body = getattr(self.fi.node, "body", [])
+        if not isinstance(body, list):   # lambda
+            body = [ast.Expr(value=body)]
+        self.exec_block(body, self.initial())
+        return self.findings
+
+    def initial(self):
+        return {}
+
+    @staticmethod
+    def merge(state, branches):
+        for b in branches:
+            for k, v in b.items():
+                state.setdefault(k, v)
+        return state
+
+    def exec_block(self, stmts, state):
+        """Returns True when the block unconditionally terminates."""
+        for stmt in stmts:
+            if isinstance(stmt, _SCOPE_NODES):
+                continue   # nested scopes analyzed separately
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self.scan_expr(stmt.value, state)
+                return True
+            if isinstance(stmt, ast.Raise):
+                if stmt.exc is not None:
+                    self.scan_expr(stmt.exc, state)
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(stmt, ast.If):
+                self.scan_expr(stmt.test, state)
+                s_body, s_else = dict(state), dict(state)
+                t_body = self.exec_block(stmt.body, s_body)
+                t_else = self.exec_block(stmt.orelse, s_else)
+                live = [s for s, t in ((s_body, t_body), (s_else, t_else))
+                        if not t]
+                if not live:
+                    return True
+                state.clear()
+                state.update(live[0])
+                self.merge(state, live[1:])
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.scan_expr(stmt.iter, state)
+                self.on_store(stmt.target, state)
+                self.exec_block(stmt.body, state)
+                self.on_store(stmt.target, state)
+                self.exec_block(stmt.body, state)   # loop-carried pass
+                self.exec_block(stmt.orelse, state)
+                continue
+            if isinstance(stmt, ast.While):
+                self.scan_expr(stmt.test, state)
+                self.exec_block(stmt.body, state)
+                self.scan_expr(stmt.test, state)
+                self.exec_block(stmt.body, state)
+                self.exec_block(stmt.orelse, state)
+                continue
+            if isinstance(stmt, ast.Try):
+                t = self.exec_block(stmt.body, state)
+                branches = []
+                for h in stmt.handlers:
+                    s_h = dict(state)
+                    self.exec_block(h.body, s_h)
+                    branches.append(s_h)
+                self.merge(state, branches)
+                if not t:
+                    self.exec_block(stmt.orelse, state)
+                self.exec_block(stmt.finalbody, state)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self.scan_expr(item.context_expr, state)
+                    if item.optional_vars is not None:
+                        self.on_store(item.optional_vars, state)
+                self.exec_block(stmt.body, state)
+                continue
+            self.exec_stmt(stmt, state)
+        return False
+
+    def exec_stmt(self, stmt, state):
+        if isinstance(stmt, ast.Assign):
+            self.scan_expr(stmt.value, state)
+            self.on_assign(stmt.targets, stmt.value, state)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.scan_expr(stmt.value, state)
+                self.on_assign([stmt.target], stmt.value, state)
+        elif isinstance(stmt, ast.AugAssign):
+            self.scan_expr(stmt.value, state)
+            self.on_store(stmt.target, state)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self.on_store(t, state)
+        elif isinstance(stmt, ast.Expr):
+            self.scan_expr(stmt.value, state)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self.scan_expr(child, state)
+
+    def scan_expr(self, e, state):
+        if e is None or isinstance(e, ast.Lambda):
+            return
+        if isinstance(e, ast.IfExp):
+            self.scan_expr(e.test, state)
+            s1, s2 = dict(state), dict(state)
+            self.scan_expr(e.body, s1)
+            self.scan_expr(e.orelse, s2)
+            state.clear()
+            state.update(s1)
+            self.merge(state, [s2])
+            return
+        if isinstance(e, ast.BoolOp):
+            self.scan_expr(e.values[0], state)
+            rest = []
+            for v in e.values[1:]:
+                s = dict(state)
+                self.scan_expr(v, s)
+                rest.append(s)
+            self.merge(state, rest)
+            return
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            for g in e.generators:
+                self.scan_expr(g.iter, state)
+            bodies = [e.key, e.value] if isinstance(e, ast.DictComp) \
+                else [e.elt]
+            for _ in range(2):   # comp body runs per-iteration
+                for b in bodies:
+                    self.scan_expr(b, state)
+            return
+        if isinstance(e, ast.Call):
+            self.scan_expr(e.func, state)
+            for a in e.args:
+                self.scan_expr(a, state)
+            for kw in e.keywords:
+                self.scan_expr(kw.value, state)
+            self.on_call(e, state)
+            return
+        self.on_expr(e, state)
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, state)
+
+    def on_assign(self, targets, value, state):
+        for t in targets:
+            self.on_store(t, state)
+
+    def on_store(self, target, state):
+        for name in self.m._target_names(target):
+            self.clear_name(name, state)
+
+    # hooks
+    def clear_name(self, name, state):
+        state.pop(name, None)
+
+    def on_call(self, call, state):
+        pass
+
+    def on_expr(self, e, state):
+        pass
+
+
+class _KeyReuse(_LinearRule):
+    """TPU003: a PRNG key variable consumed by two sampling ops without
+    an intervening split/reassignment."""
+
+    def on_call(self, call, state):
+        name = self.m.resolve(call.func)
+        if not name:
+            return
+        ns = next((p for p in I.RANDOM_NAMESPACES
+                   if name.startswith(p)), None)
+        if ns is None:
+            return
+        leaf = name[len(ns):]
+        if "." in leaf or leaf in I.RANDOM_KEY_DERIVERS:
+            return
+        key_arg = call.args[0] if call.args else next(
+            (kw.value for kw in call.keywords if kw.arg == "key"), None)
+        if not isinstance(key_arg, ast.Name):
+            return
+        k = key_arg.id
+        if k in state:
+            self.report(
+                "TPU003", call,
+                f"PRNG key `{k}` already consumed by a sampler at line "
+                f"{state[k]} — reusing it makes correlated randomness; "
+                "jax.random.split (or fold_in) first")
+        else:
+            state[k] = call.lineno
+
+
+class _DonatedUse(_LinearRule):
+    """TPU004: an argument passed at a donate_argnums position is read
+    again after the donating call (its buffer is invalid)."""
+
+    def initial(self):
+        return {"jit": {}, "donated": {}, "layouts": {}}
+
+    @staticmethod
+    def merge(state, branches):
+        for b in branches:
+            for k in ("jit", "donated", "layouts"):
+                for name, v in b.get(k, {}).items():
+                    state[k].setdefault(name, v)
+        return state
+
+    def clear_name(self, name, state):
+        state["jit"].pop(name, None)
+        state["donated"].pop(name, None)
+        state["layouts"].pop(name, None)
+
+    def _positions_from(self, val, state):
+        """Donation positions of one expression: int/tuple literals,
+        the `X if flag else ()` idiom, `introspect.*_DONATE_ARGNUMS`
+        constants, or a local name previously bound to any of those."""
+        if isinstance(val, ast.IfExp):
+            return self._positions_from(val.body, state) or \
+                self._positions_from(val.orelse, state)
+        if isinstance(val, ast.Constant) and isinstance(val.value, int):
+            return (val.value,)
+        if isinstance(val, (ast.Tuple, ast.List)):
+            out = tuple(e.value for e in val.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int))
+            return out or None
+        if isinstance(val, ast.Name) and val.id in state["layouts"]:
+            return state["layouts"][val.id]
+        name = self.m.resolve(val)
+        if name:
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in I.DONATION_CONSTANTS and \
+                    (name == leaf or ".introspect." in f".{name}"):
+                return I.DONATION_CONSTANTS[leaf]
+        return None
+
+    def _donate_positions(self, call, state):
+        for kw in call.keywords:
+            if kw.arg in I.DONATE_ARG_KEYWORDS:
+                out = self._positions_from(kw.value, state)
+                if out:
+                    return out
+        return None
+
+    def _donate_args(self, call, positions, state):
+        for pos in positions:
+            if pos < len(call.args) and \
+                    isinstance(call.args[pos], ast.Name):
+                name = call.args[pos].id
+                state["donated"][name] = call.lineno
+
+    def on_expr(self, e, state):
+        if isinstance(e, ast.Name) and isinstance(e.ctx, ast.Load) and \
+                e.id in state["donated"]:
+            line = state["donated"].pop(e.id)
+            self.report(
+                "TPU004", e,
+                f"`{e.id}` was donated to the jitted call at line "
+                f"{line} (donate_argnums) — its buffer is invalid "
+                "here; use the call's RESULT or drop the donation")
+
+    def on_call(self, call, state):
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in state["jit"]:
+            self._donate_args(call, state["jit"][f.id], state)
+            return
+        # immediate form: jax.jit(f, donate_argnums=...)(args)
+        if isinstance(f, ast.Call) and \
+                self.m.resolve(f.func) in I.JIT_LIKE:
+            positions = self._donate_positions(f, state)
+            if positions:
+                self._donate_args(call, positions, state)
+
+    def on_assign(self, targets, value, state):
+        super().on_assign(targets, value, state)
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            return
+        if isinstance(value, ast.Call) and \
+                self.m.resolve(value.func) in I.JIT_LIKE:
+            positions = self._donate_positions(value, state)
+            if positions:
+                state["jit"][targets[0].id] = positions
+        else:
+            # donate = introspect.TRAINSTEP_DONATE_ARGNUMS if ... else ()
+            positions = self._positions_from(value, state)
+            if positions:
+                state["layouts"][targets[0].id] = positions
+
+
+def rule_tpu003(m):
+    out = []
+    for fi in m.functions:
+        out.extend(_KeyReuse(m, fi).run())
+    return out
+
+
+def rule_tpu004(m):
+    out = []
+    for fi in m.functions:
+        out.extend(_DonatedUse(m, fi).run())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU005 — python side effects under trace
+# ---------------------------------------------------------------------------
+
+def _bound_outward(fi, name, m):
+    scope = fi.parent
+    while scope is not None:
+        if name in scope.local_bindings or name in scope.children:
+            return True
+        scope = scope.parent
+    return name in m.aliases
+
+
+def rule_tpu005(m):
+    out = []
+    for fi in m.traced_functions():
+        for node in fi.nodes:
+            if isinstance(node, ast.Call):
+                f = node.func
+                name = m.resolve(f)
+                if name in I.IMPURE_CALLS or (name and any(
+                        name.startswith(p)
+                        for p in I.IMPURE_CALL_PREFIXES)):
+                    out.append(m.finding(
+                        "TPU005", node,
+                        f"`{name}` inside traced code runs ONCE at "
+                        "trace time and bakes a constant into the "
+                        "compiled program; hoist it out (or pass the "
+                        "value in as an argument)", fi))
+                elif isinstance(f, ast.Attribute) and \
+                        f.attr in _MUTATORS and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id not in fi.local_bindings and \
+                        _bound_outward(fi, f.value.id, m):
+                    out.append(m.finding(
+                        "TPU005", node,
+                        f"mutating closed-over `{f.value.id}` inside "
+                        "traced code happens once at trace time, not "
+                        "per step; return the value instead", fi))
+            elif isinstance(node, ast.Assign) and fi.global_names:
+                hit = [n for t in node.targets
+                       for n in m._target_names(t)
+                       if n in fi.global_names]
+                if hit:
+                    out.append(m.finding(
+                        "TPU005", node,
+                        f"assigning global `{hit[0]}` inside traced "
+                        "code happens once at trace time; return the "
+                        "value instead", fi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU006 — unordered iteration building ordered structures
+# ---------------------------------------------------------------------------
+
+def _set_names(m, fi):
+    """Names in this scope that only ever hold set values."""
+    setlike, other = set(), set()
+    for node in fi.nodes:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            (setlike if _is_setlike(m, node.value, ())
+             else other).add(node.targets[0].id)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value:
+            (setlike if _is_setlike(m, node.value, ())
+             else other).add(node.target.id)
+    return setlike - other
+
+
+def _is_setlike(m, e, set_names):
+    if isinstance(e, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(e, ast.Name):
+        return e.id in set_names
+    if isinstance(e, ast.Call):
+        return m.resolve(e.func) in ("set", "frozenset")
+    if isinstance(e, ast.BinOp) and isinstance(
+            e.op, (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)):
+        return _is_setlike(m, e.left, set_names) or \
+            _is_setlike(m, e.right, set_names)
+    return False
+
+
+def _builds_ordered(body):
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("append", "extend", "insert"):
+                return True
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Subscript) for t in node.targets):
+                return True
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+_MSG006 = ("iterating a set here feeds an ORDERED structure: python "
+           "set order varies across processes (hash seed), so pytree "
+           "flatten order / param dicts diverge across ranks; iterate "
+           "sorted(...) instead")
+
+
+#: Consumers whose result does not depend on iteration order — a
+#: comprehension over a set fed DIRECTLY to one of these is fine
+#: (mirrors the for-loop branch's _builds_ordered gate).
+_ORDER_FREE_CONSUMERS = {"any", "all", "sum", "min", "max", "len",
+                         "set", "frozenset", "sorted"}
+
+
+def rule_tpu006(m):
+    out = []
+    for fi in m.functions:
+        names = _set_names(m, fi)
+        order_free = set()
+        for node in fi.nodes:
+            if isinstance(node, ast.Call) and \
+                    m.resolve(node.func) in _ORDER_FREE_CONSUMERS:
+                order_free.update(id(a) for a in node.args)
+        for node in fi.nodes:
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                if _is_setlike(m, node.iter, names) and \
+                        _builds_ordered(node.body):
+                    out.append(m.finding("TPU006", node, _MSG006, fi))
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp)):
+                if id(node) not in order_free and \
+                        any(_is_setlike(m, g.iter, names)
+                            for g in node.generators):
+                    out.append(m.finding("TPU006", node, _MSG006, fi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU007 — eager collectives under trace
+# ---------------------------------------------------------------------------
+
+def rule_tpu007(m):
+    out = []
+    eager = {p + n for p in I.EAGER_COLLECTIVE_PREFIXES
+             for n in I.EAGER_COLLECTIVES}
+    for fi in m.traced_functions():
+        for node in _owned_calls(fi):
+            name = m.resolve(node.func)
+            if name in eager:
+                out.append(m.finding(
+                    "TPU007", node,
+                    f"`{name}` is an EAGER collective (runs its own "
+                    "compiled program and blocks the host) — inside "
+                    "traced code use mesh primitives (jax.lax.psum / "
+                    "shard_map) or thread it through the spmd step",
+                    fi))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU008 — contraction without pinned accumulator dtype in bf16 paths
+# ---------------------------------------------------------------------------
+
+_LOOP_BODY_VIAS = ("jax.lax.scan", "jax.lax.fori_loop",
+                   "jax.lax.while_loop", "jax.lax.map",
+                   "jax.lax.associative_scan")
+
+
+def _unwrap_cast(e):
+    """`einsum(...).astype(t)` — look through the cast to the
+    contraction."""
+    if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute) \
+            and e.func.attr == "astype":
+        return e.func.value
+    return e
+
+
+def rule_tpu008(m):
+    """A contraction without a pinned accumulator dtype is only the
+    PR-3 bug class when its OUTPUT is accumulated: summed with a
+    running value (`acc + einsum(...)`, `acc += ...`) or recomputed
+    per iteration of a loop body (python loop or a staged
+    lax.scan/fori_loop body). A standalone bf16 matmul accumulates
+    inside the MXU at fp32 and is fine."""
+    out = []
+    for fi in m.functions:
+        if not fi.effective_bf16():
+            continue
+        cands = {}
+        for node in fi.nodes:
+            if isinstance(node, ast.Call):
+                name = m.resolve(node.func)
+                if name in I.CONTRACTION_CALLS and not any(
+                        kw.arg == I.ACCUM_DTYPE_KEYWORD
+                        for kw in node.keywords):
+                    cands[id(node)] = (node, name)
+            elif isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.MatMult):
+                cands[id(node)] = (node, "@")
+        if not cands:
+            continue
+        accumulating = set()
+        if fi.trace_via in _LOOP_BODY_VIAS:
+            accumulating |= set(cands)          # staged loop body
+        for node in fi.nodes:
+            if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                          ast.Add):
+                for side in (node.left, node.right):
+                    side = _unwrap_cast(side)
+                    if id(side) in cands:
+                        accumulating.add(id(side))
+            elif isinstance(node, ast.AugAssign) and \
+                    isinstance(node.op, ast.Add):
+                for sub in ast.walk(node.value):
+                    if id(sub) in cands:
+                        accumulating.add(id(sub))
+            elif isinstance(node, (ast.For, ast.While)):
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        if id(sub) in cands:
+                            accumulating.add(id(sub))
+        # iterate cands (AST-walk order), not the set — finding order
+        # must be deterministic (tpu-lint's own TPU006)
+        for key, (node, name) in cands.items():
+            if key not in accumulating:
+                continue
+            what = "`@` matmul" if name == "@" else f"`{name}`"
+            out.append(m.finding(
+                "TPU008", node,
+                f"{what} output is ACCUMULATED in a bf16 code path "
+                f"without `{I.ACCUM_DTYPE_KEYWORD}` — partial sums at "
+                "bf16 cancel catastrophically (the paged-attention PV "
+                "bug class); pin jnp.float32 and cast once after the "
+                "accumulation", fi))
+    return out
+
+
+RULES = {
+    "TPU000": ("parse-error",
+               "file could not be parsed (reported, never skipped)",
+               None),
+    "TPU001": ("host-sync-in-trace",
+               "device->host sync (.item/.tolist/.numpy, float/int, "
+               "np.asarray, print) of a traced value inside traced code",
+               rule_tpu001),
+    "TPU002": ("python-branch-on-tracer",
+               "python if/while/assert on a traced boolean — "
+               "recompile or ConcretizationError hazard",
+               rule_tpu002),
+    "TPU003": ("prng-key-reuse",
+               "same PRNG key consumed by two samplers without an "
+               "intervening split",
+               rule_tpu003),
+    "TPU004": ("donated-buffer-use",
+               "argument at a donate_argnums position read after the "
+               "donating call",
+               rule_tpu004),
+    "TPU005": ("side-effect-in-trace",
+               "python side effects under trace (closure/global "
+               "mutation, wall-clock, python RNG)",
+               rule_tpu005),
+    "TPU006": ("unordered-iteration",
+               "iterating a set into an ordered structure — "
+               "nondeterministic flatten order across ranks",
+               rule_tpu006),
+    "TPU007": ("eager-collective-in-trace",
+               "eager paddle_tpu.distributed collective called from "
+               "traced code",
+               rule_tpu007),
+    "TPU008": ("accum-dtype-trap",
+               "contraction without preferred_element_type in a bf16 "
+               "code path",
+               rule_tpu008),
+}
+
+
+def all_rule_ids():
+    return sorted(RULES)

@@ -53,7 +53,9 @@ def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStra
         warnings.warn(
             f"hybrid_configs keys {sorted(unknown)} are not understood "
             f"and will be ignored (degrees: {sorted(degree_keys)})")
-    degrees = {k: int(hc.get(k, 1)) for k in degree_keys}
+    # sorted: every rank must build `degrees` in the same order — set
+    # order varies with the hash seed across processes (tpu-lint TPU006)
+    degrees = {k: int(hc.get(k, 1)) for k in sorted(degree_keys)}
     bad = {k: v for k, v in degrees.items() if v < 1}
     if bad:
         raise ValueError(f"hybrid_configs degrees must be >= 1: {bad}")

@@ -204,7 +204,10 @@ class Coordinator:
             # contaminate the round's average
             if not joined or not joined <= set(self._round_updates):
                 return
-            folded = {c: self._round_updates[c] for c in joined}
+            # sorted: the weighted fold below sums floats in `folded`
+            # order — set order varies with the hash seed, making the
+            # folded global model irreproducible (tpu-lint TPU006)
+            folded = {c: self._round_updates[c] for c in sorted(joined)}
             total = sum(n for _, n in folded.values())
             # a zero-sample push still counts as round PARTICIPATION
             # (rejecting it would wedge the fold gate and deadlock the

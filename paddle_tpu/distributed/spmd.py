@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import introspect
 
 from .topology import HybridCommunicateGroup, get_hybrid_communicate_group
 
@@ -213,7 +214,8 @@ class DistributedTrainStep:
             {k: acc_shardings[k] for k in accum_names},
             self._buf_shardings(),
         )
-        donate = (0, 1, 2) if self._donate else ()
+        donate = introspect.TRAINSTEP_DONATE_ARGNUMS if self._donate \
+            else ()
         return jax.jit(step_fn, donate_argnums=donate,
                        out_shardings=out_shardings)
 
@@ -301,7 +303,8 @@ class DistributedTrainStep:
                                          self._buf_shardings()))
         upd_jit = jax.jit(
             upd_fn,
-            donate_argnums=(0, 1, 2) if self._donate else (),
+            donate_argnums=introspect.TRAINSTEP_DONATE_ARGNUMS
+            if self._donate else (),
             out_shardings=(param_sh, acc_sh, buf_sh))
         return acc_jit, upd_jit
 

@@ -1,0 +1,207 @@
+"""Trace-machinery introspection metadata — the jit layer's own
+description of which APIs stage python callables into XLA programs,
+which call keywords mark arguments static or donated, and which
+sibling-module calls are host-blocking when issued under a trace.
+
+This module is deliberately PURE DATA (no jax import, no framework
+import): `paddle_tpu.analysis` (tpu-lint) reads it to resolve
+jit-reachability and donation statically, and `jit.api` consumes the
+donation constants for its own `jax.jit(..., donate_argnums=...)`
+calls — one source of truth instead of the analyzer string-matching
+the framework's internals.
+
+Names are CANONICAL dotted paths as the analyzer resolves them through
+import aliases (`import jax.numpy as jnp` resolves `jnp.matmul` to
+`jax.numpy.matmul`).
+"""
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Trace entry points
+# ---------------------------------------------------------------------------
+
+#: Decorators that make the decorated function a traced program.
+#: Maps canonical name -> "kind". Kind "dy2static" means the wrapper
+#: runs the dy2static AST pass first, so python `if`/`while` on traced
+#: booleans are converted to lax.cond/while_loop (TPU002 exempts the
+#: directly-wrapped function body; its callees are NOT transformed).
+TRACE_DECORATORS = {
+    "jax.jit": "jit",
+    "jax.pmap": "jit",
+    "paddle_tpu.jit.to_static": "dy2static",
+    "paddle_tpu.jit.api.to_static": "dy2static",
+}
+
+#: Callables that stage a python-callable ARGUMENT into traced code.
+#: Maps canonical name -> tuple of traced-callable positional indices.
+#: For jax.lax.switch the branch list at index 1 is a sequence of
+#: callables (the analyzer unpacks list/tuple literals at any traced
+#: position).
+TRACING_CALLABLES = {
+    "jax.jit": (0,),
+    "jax.pmap": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.jacfwd": (0,),
+    "jax.jacrev": (0,),
+    "jax.hessian": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.eval_shape": (0,),
+    "jax.make_jaxpr": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": (1,),
+    "jax.lax.associative_scan": (0,),
+    "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,),
+    "jax.experimental.pallas.pallas_call": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+    "paddle_tpu.jit.to_static": (0,),
+    "paddle_tpu.jit.api.to_static": (0,),
+}
+
+#: The subset of TRACING_CALLABLES / TRACE_DECORATORS that accept
+#: static/donate keywords (jit-like signatures).
+JIT_LIKE = {"jax.jit", "jax.pmap"}
+
+#: Wrappers that return their first argument's callable semantics
+#: unchanged — `jax.jit(count_traces(f))` traces f. The analyzer
+#: stages through them.
+PASSTHROUGH_WRAPPERS = {
+    "paddle_tpu.jit.count_traces",
+    "paddle_tpu.jit.api.count_traces",
+    "functools.partial",
+    "functools.wraps",
+}
+
+#: Call keywords that mark arguments STATIC (python values re-traced
+#: per value, never tracers) and DONATED (buffer invalidated by the
+#: call).
+STATIC_ARG_KEYWORDS = ("static_argnums", "static_argnames")
+DONATE_ARG_KEYWORDS = ("donate_argnums", "donate_argnames")
+
+#: Decorator marking a function explicitly NOT traced
+#: (paddle_tpu.jit.not_to_static).
+NOT_TRACED_DECORATORS = {
+    "paddle_tpu.jit.not_to_static",
+    "paddle_tpu.jit.api.not_to_static",
+}
+
+# ---------------------------------------------------------------------------
+# Donation layout of the framework's own compiled steps
+# ---------------------------------------------------------------------------
+
+#: jit.TrainStep donates (param_arrays, accums, bufs) — the first three
+#: positional arguments of every step/scan/repeat program — so the
+#: optimizer update happens in-place in HBM. The accumulate path's
+#: acc_fn donates only its grad buffers (position 0).
+TRAINSTEP_DONATE_ARGNUMS = (0, 1, 2)
+ACCUM_DONATE_ARGNUMS = (0,)
+
+#: Named donation layouts by constant name — TPU004 resolves a
+#: `donate_argnums=introspect.<NAME>` expression through this table,
+#: so the framework's own jit sites stay visible to the rule.
+DONATION_CONSTANTS = {
+    "TRAINSTEP_DONATE_ARGNUMS": TRAINSTEP_DONATE_ARGNUMS,
+    "ACCUM_DONATE_ARGNUMS": ACCUM_DONATE_ARGNUMS,
+}
+
+# ---------------------------------------------------------------------------
+# Host-sync / side-effect surfaces (TPU001 / TPU005)
+# ---------------------------------------------------------------------------
+
+#: Method names that force a device->host transfer of their receiver.
+#: `.numpy()` is this framework's Tensor sync (core.tensor.Tensor).
+HOST_SYNC_METHODS = ("item", "tolist", "numpy")
+
+#: Free functions that concretize a traced value on host.
+HOST_SYNC_CALLS = {
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+}
+
+#: Builtins that concretize a traced scalar (bool-coercion hazards are
+#: TPU002's domain — branches are where they bite).
+HOST_SYNC_BUILTINS = ("float", "int")
+
+#: Wall-clock / python-RNG calls that are side effects under trace:
+#: they execute ONCE at trace time and bake a constant into the
+#: compiled program.
+IMPURE_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "random.random",
+    "random.randint",
+    "random.uniform",
+    "random.choice",
+    "random.shuffle",
+    "random.sample",
+}
+
+#: Module prefixes whose calls are impure under trace (numpy's global
+#: RNG draws a host value at trace time).
+IMPURE_CALL_PREFIXES = ("numpy.random.",)
+
+# ---------------------------------------------------------------------------
+# PRNG key discipline (TPU003)
+# ---------------------------------------------------------------------------
+
+#: jax.random functions that DERIVE fresh keys (passing a key here does
+#: not "spend" it for reuse purposes — though using the parent after a
+#: plain split is still caught when the parent is sampled twice).
+RANDOM_KEY_DERIVERS = ("split", "fold_in", "PRNGKey", "key", "clone",
+                       "key_data", "wrap_key_data")
+
+#: Prefixes under which a first-argument key is CONSUMED by a sampler.
+RANDOM_NAMESPACES = ("jax.random.",)
+
+# ---------------------------------------------------------------------------
+# Eager collectives (TPU007)
+# ---------------------------------------------------------------------------
+
+#: paddle_tpu.distributed functions that run their OWN compiled
+#: program over the mesh and block the host — calling one inside a
+#: traced function either fails to trace or silently stages a nested
+#: dispatch. Traced code must use mesh-level primitives
+#: (jax.lax.psum / shard_map) or the spmd TrainStep shardings instead.
+#: tests assert this list stays in sync with paddle_tpu.distributed's
+#: public eager API.
+EAGER_COLLECTIVES = (
+    "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+    "alltoall", "reduce_scatter", "send", "recv", "isend", "irecv",
+    "batch_isend_irecv", "barrier",
+)
+
+EAGER_COLLECTIVE_PREFIXES = (
+    "paddle_tpu.distributed.",
+    "paddle_tpu.distributed.collective.",
+)
+
+# ---------------------------------------------------------------------------
+# Dtype-widening surfaces (TPU008)
+# ---------------------------------------------------------------------------
+
+#: Contraction ops whose accumulator dtype follows the operand dtype
+#: unless preferred_element_type pins it — the bf16 cancellation bug
+#: class (see DESIGN_DECISIONS on the paged-attention PV fix).
+CONTRACTION_CALLS = {
+    "jax.numpy.matmul",
+    "jax.numpy.dot",
+    "jax.numpy.einsum",
+    "jax.numpy.tensordot",
+    "jax.lax.dot_general",
+    "jax.lax.dot",
+}
+
+ACCUM_DTYPE_KEYWORD = "preferred_element_type"

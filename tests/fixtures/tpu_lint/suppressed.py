@@ -1,0 +1,15 @@
+import jax
+
+log = []
+
+
+@jax.jit
+def suppressed_effect(x):
+    log.append(1)  # tpu-lint: disable=TPU005
+    return x
+
+
+@jax.jit
+def unsuppressed_effect(x):
+    log.append(2)
+    return x

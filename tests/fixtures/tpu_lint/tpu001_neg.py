@@ -1,0 +1,13 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced(x):
+    n = int(x.shape[0])
+    return x * n
+
+
+def eager(x):
+    return float(np.asarray(x).sum())

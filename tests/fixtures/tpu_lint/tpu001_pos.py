@@ -1,0 +1,16 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced(x):
+    v = float(x.sum())
+    np.asarray(x)
+    print(x)
+    return v
+
+
+@jax.jit
+def method_sync(x):
+    return x.item()

@@ -1,0 +1,29 @@
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.jit import to_static
+
+
+@jax.jit
+def static_branches(x, flag=None):
+    if flag is None:
+        x = x + 1.0
+    if x.shape[0] > 1:
+        x = x * 2.0
+    return x
+
+
+@to_static
+def dy2static_branch(x):
+    if x.sum() > 0:
+        return x
+    return -x
+
+
+def staticized(x, n):
+    if n > 2:
+        return x * 2.0
+    return x / 2.0
+
+
+traced = jax.jit(staticized, static_argnums=(1,))

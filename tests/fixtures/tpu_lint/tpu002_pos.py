@@ -1,0 +1,18 @@
+import jax
+import jax.numpy as jnp
+
+
+def branch_on_operand(x, n):
+    if n > 2:
+        return x * 2.0
+    return x / 2.0
+
+
+traced = jax.jit(branch_on_operand)
+
+
+@jax.jit
+def loop_on_value(x):
+    while x.sum() > 0:
+        x = x - 1.0
+    return x

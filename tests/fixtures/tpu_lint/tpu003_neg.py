@@ -1,0 +1,22 @@
+import jax
+
+
+def split_between(key):
+    a = jax.random.normal(key, (2,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (2,))
+    return a + b
+
+
+def branches_consume_once(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key, (2,))
+
+
+def fresh_key_per_loop(key, xs):
+    out = []
+    for i, _x in enumerate(xs):
+        k = jax.random.fold_in(key, i)
+        out.append(jax.random.normal(k, (2,)))
+    return out

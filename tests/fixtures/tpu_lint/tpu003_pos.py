@@ -1,0 +1,14 @@
+import jax
+
+
+def double_sample(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a + b
+
+
+def loop_reuse(key, xs):
+    out = []
+    for _x in xs:
+        out.append(jax.random.normal(key, (2,)))
+    return out

@@ -1,0 +1,21 @@
+import jax
+import jax.numpy as jnp
+
+
+def rebind_donated(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    x = step(x, y)
+    return x * 2.0
+
+
+def read_non_donated(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y)
+    return y + out
+
+
+def loop_rebinds(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    for _ in range(3):
+        x = step(x, y)
+    return x

@@ -1,0 +1,15 @@
+import jax
+import jax.numpy as jnp
+
+
+def use_after_donate(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    out = step(x, y)
+    return x * 2.0 + out
+
+
+def loop_carried_donation(x, y):
+    step = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+    for _ in range(3):
+        out = step(x, y)
+    return out

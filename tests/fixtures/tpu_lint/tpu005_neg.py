@@ -1,0 +1,15 @@
+import time
+
+import jax
+
+
+@jax.jit
+def pure(x):
+    acc = []
+    acc.append(x * 2.0)
+    return acc[0]
+
+
+def host_wrapper(x):
+    t0 = time.time()
+    return x, t0

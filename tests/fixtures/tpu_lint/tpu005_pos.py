@@ -1,0 +1,12 @@
+import time
+
+import jax
+
+log = []
+
+
+@jax.jit
+def side_effects(x):
+    log.append(1)
+    t = time.time()
+    return x * t

@@ -1,0 +1,24 @@
+def sorted_iteration(tensors):
+    out = []
+    for name in sorted(set(tensors)):
+        out.append(tensors[name])
+    return out
+
+
+def membership_only(keys, k):
+    allowed = set(keys)
+    return k in allowed
+
+
+def order_free_loop(keys):
+    seen = set(keys)
+    for k in seen:
+        print(k)
+
+
+def order_free_comprehensions(keys, other):
+    seen = set(keys)
+    hit = any(k in other for k in seen)
+    count = sum(1 for k in seen)
+    ordered = sorted(k for k in seen)
+    return hit, count, ordered

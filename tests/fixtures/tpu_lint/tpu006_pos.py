@@ -1,0 +1,9 @@
+def build_params(tensors):
+    out = []
+    for name in set(tensors):
+        out.append(tensors[name])
+    return out
+
+
+def comp_over_set(keys):
+    return {k: 0.0 for k in set(keys)}

@@ -1,0 +1,13 @@
+import jax
+
+import paddle_tpu.distributed as dist
+
+
+def eager_allreduce(x):
+    dist.all_reduce(x)
+    return x
+
+
+@jax.jit
+def mesh_collective(x):
+    return jax.lax.psum(x, "dp")

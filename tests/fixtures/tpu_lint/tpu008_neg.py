@@ -1,0 +1,16 @@
+import jax
+import jax.numpy as jnp
+
+
+def pinned_accumulate(blocks, q):
+    acc = jnp.zeros((4, 8), jnp.float32)
+    for b in blocks:
+        b16 = b.astype(jnp.bfloat16)
+        acc = acc + jnp.matmul(q, b16,
+                               preferred_element_type=jnp.float32)
+    return acc
+
+
+def standalone_matmul(a, b):
+    a16 = a.astype(jnp.bfloat16)
+    return jnp.matmul(a16, b)

@@ -1,0 +1,10 @@
+import jax
+import jax.numpy as jnp
+
+
+def block_accumulate(blocks, q):
+    acc = jnp.zeros((4, 8), jnp.float32)
+    for b in blocks:
+        b16 = b.astype(jnp.bfloat16)
+        acc = acc + jnp.matmul(q, b16)
+    return acc

@@ -162,3 +162,20 @@ def test_repo_resnet_row_carries_decided_floor():
     with open(os.path.join(REPO, "BENCH_BASELINE.json")) as f:
         base = json.load(f)
     assert base["resnet50_train_images_per_sec_per_chip"]["floor"] == 2350.0
+
+
+def test_pending_smoke_flags_unadopted_opbench_rows():
+    """--pending smoke (ISSUE 4 satellite): the suite rows added by
+    PRs 1-3 stay VISIBLY pending until a TPU `bench_ops.py --save`
+    refresh adopts them — the gate must keep saying so, loudly."""
+    res = _run(["--pending", os.path.join(REPO, "OPBENCH.json")])
+    assert res.returncode == 0, res.stdout + res.stderr  # report-only
+    for row in ("gpt_decode_kv_350m", "gpt_engine_offered_load",
+                "paged_attention_decode_sweep",
+                "gpt_engine_offered_load_pallas"):
+        assert f"PENDING: {row}" in res.stdout, res.stdout
+    assert "pending row(s) not gated" in res.stdout
+    # --strict turns the report into a failure
+    res = _run(["--pending", os.path.join(REPO, "OPBENCH.json"),
+                "--strict"])
+    assert res.returncode == 1

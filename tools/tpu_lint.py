@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""tpu-lint — trace-safety & recompile-hazard static analyzer.
+
+Usage:
+    python tools/tpu_lint.py paddle_tpu bench_ops.py tools
+    python tools/tpu_lint.py --stats --format=json some/file.py
+    python tools/tpu_lint.py --list-rules
+
+See README "Static analysis" for the rule table and suppression
+etiquette. Runs as a tier-1 gate (tests/test_tpu_lint_gate.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

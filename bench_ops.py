@@ -101,6 +101,7 @@ SUITE_ROWS = (
     "embedding_50k", "reduce_sum_64M", "gpt_decode_kv_32tok",
     "gpt_decode_kv_350m", "gpt_engine_offered_load",
     "paged_attention_decode_sweep", "gpt_engine_offered_load_pallas",
+    "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill",
 )
 
 
@@ -197,6 +198,8 @@ def suite():
     cases["paged_attention_decode_sweep"] = _paged_attention_sweep_case()
     cases["gpt_engine_offered_load_pallas"] = _engine_offered_load_case(
         attention_backend="pallas")
+    cases["gpt_engine_prefix_cache"] = _engine_prefix_cache_case()
+    cases["gpt_engine_chunked_prefill"] = _engine_chunked_prefill_case()
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -443,6 +446,185 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
                     ["series"][0]["value"]),
                 "decode_recompiles": int(series_total(
                     snap, "engine_decode_recompiles_total"))}
+
+    return run_bench
+
+
+def _tpot_pct(snap, q):
+    """Tail TPOT from the engine's histogram, counts summed across the
+    priority-labeled series (ms, or None before any observation)."""
+    from paddle_tpu.observability.metrics import quantile_from_buckets
+
+    fam = snap["engine_tpot_seconds"]
+    if not fam["series"]:
+        return None
+    counts = [sum(s["counts"][i] for s in fam["series"])
+              for i in range(len(fam["series"][0]["counts"]))]
+    v = quantile_from_buckets(fam["buckets"], counts, q)
+    return None if v is None else round(v * 1e3, 3)
+
+
+def _engine_prefix_cache_case(model_cfg=None, num_tenants=4,
+                              per_tenant=6, uniques=8, prefix_len=64,
+                              suffix_max=32, max_new=32, num_slots=8,
+                              block_size=16, prefill_chunk=64, seed=0):
+    """Prefix-cache serving row: a multi-tenant trace (each tenant is a
+    hot shared system prompt carried by `per_tenant` requests with
+    unique suffixes, plus `uniques` long-tail one-off prompts) served
+    twice by ONE engine. The first wave computes and publishes every
+    tenant prefix; the second wave (fresh suffixes, same tenants) must
+    seat the shared blocks from the cache — the record proves it with
+    the hit-token counter and a strictly lower prefill-chunk count,
+    and the tracked numbers are warm tokens/s + warm tail TPOT vs the
+    cold wave's. Runs chunked prefill + prefix cache (the default
+    scheduler this row exists to track)."""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.inference import GenerationEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.observability.metrics import series_total
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        engine = GenerationEngine(model, num_slots=num_slots,
+                                  block_size=block_size,
+                                  prefill_chunk=prefill_chunk)
+        tenants = [rng.randint(0, cfg.vocab_size, prefix_len)
+                   for _ in range(num_tenants)]
+
+        def wave():
+            reqs = []
+            for pre in tenants:
+                for _ in range(per_tenant):
+                    sfx = rng.randint(0, cfg.vocab_size,
+                                      rng.randint(1, suffix_max + 1))
+                    reqs.append(np.concatenate([pre, sfx]))
+            for _ in range(uniques):
+                reqs.append(rng.randint(
+                    0, cfg.vocab_size,
+                    rng.randint(prefix_len // 2, prefix_len * 2)))
+            return reqs
+
+        def serve(reqs):
+            base = engine.tokens_generated
+            t0 = time.perf_counter()
+            for p in reqs:
+                engine.add_request(p, max_new_tokens=max_new)
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            assert len(out) == len(reqs)
+            return dt, engine.tokens_generated - base
+
+        # compile warmup (chunk + decode programs), off the record
+        engine.add_request(
+            rng.randint(0, cfg.vocab_size, prefill_chunk + 1), 2)
+        engine.run()
+        engine.metrics.reset()
+        dt_cold, toks_cold = serve(wave())
+        snap = engine.metrics_snapshot()
+        chunks_cold = series_total(snap, "engine_prefill_chunks_total")
+        tpot_cold = _tpot_pct(snap, 0.99)
+        engine.metrics.reset()
+        dt_warm, toks_warm = serve(wave())   # fresh suffixes, hot cache
+        snap = engine.metrics_snapshot()
+        chunks_warm = series_total(snap, "engine_prefill_chunks_total")
+        hit = int(series_total(snap,
+                               "engine_prefix_cache_hit_tokens_total"))
+        assert hit > 0, "warm wave must serve prefix hits"
+        assert chunks_warm < chunks_cold, \
+            "prefix hits must shrink prefill compute"
+        return {"ms": round(dt_warm * 1e3, 1),
+                "tokens_per_s": round(toks_warm / dt_warm),
+                "cold_tokens_per_s": round(toks_cold / dt_cold),
+                "hit_tokens": hit,
+                "prefill_chunks_cold": int(chunks_cold),
+                "prefill_chunks_warm": int(chunks_warm),
+                "tpot_ms_p99": _tpot_pct(snap, 0.99),
+                "tpot_ms_p99_cold": tpot_cold,
+                "cached_blocks": int(
+                    snap["engine_prefix_cached_blocks"]["series"][0]
+                    ["value"]),
+                "requests_per_wave":
+                    num_tenants * per_tenant + uniques}
+
+    return run_bench
+
+
+def _engine_chunked_prefill_case(model_cfg=None, long_prompt=384,
+                                 decode_lanes=4, max_new=48,
+                                 num_slots=6, block_size=16,
+                                 prefill_chunk=64, seed=0):
+    """Chunked-prefill tail-latency row: `decode_lanes` short-prompt
+    requests decode steadily while a LONG prompt is admitted mid-
+    stream — once through the chunked scheduler (one chunk per
+    iteration interleaves with decode) and once through the legacy
+    whole-prompt bucketed prefill (the admission monopolizes an
+    iteration). The tracked numbers are the decode lanes' tail TPOT
+    under each mode; on TPU the whole-prompt p99 spikes by the full
+    long-prefill latency while the chunked p99 is bounded by one
+    chunk. (CPU CI only asserts both modes run and report.)"""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.inference import GenerationEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        short = [rng.randint(0, cfg.vocab_size,
+                             rng.randint(4, 2 * block_size))
+                 for _ in range(decode_lanes)]
+        long_p = rng.randint(0, cfg.vocab_size, long_prompt)
+
+        def serve(**engine_kw):
+            engine = GenerationEngine(model, num_slots=num_slots,
+                                      block_size=block_size,
+                                      **engine_kw)
+            # warm every compiled program off the record (the chunked
+            # engine runs cache-off so this warm-up cannot seed prefix
+            # hits that would skip the prefill being measured)
+            engine.add_request(long_p, 2)
+            engine.add_request(short[0], 2)
+            engine.run()
+            engine.metrics.reset()
+            t0 = time.perf_counter()
+            for p in short:
+                engine.add_request(p, max_new_tokens=max_new)
+            for _ in range(3):
+                engine.step()          # lanes are decoding...
+            engine.add_request(long_p, max_new_tokens=8)  # ...bomb
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            assert len(out) == decode_lanes + 1
+            return dt, _tpot_pct(engine.metrics_snapshot(), 0.99)
+
+        dt_chunked, p99_chunked = serve(prefill_chunk=prefill_chunk,
+                                        enable_prefix_cache=False)
+        buckets = tuple(b for b in (32, 64, 128, 256, cfg.max_seq_len)
+                        if b <= cfg.max_seq_len)
+        _, p99_whole = serve(prefill_buckets=buckets)
+        return {"ms": round(dt_chunked * 1e3, 1),
+                "prefill_chunk": prefill_chunk,
+                "long_prompt": long_prompt,
+                "tpot_ms_p99_chunked": p99_chunked,
+                "tpot_ms_p99_whole": p99_whole}
 
     return run_bench
 

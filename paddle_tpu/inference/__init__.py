@@ -19,12 +19,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.inference.engine import (GenerationEngine, PagedKVCache,
+from paddle_tpu.inference.engine import (PRIORITY_CLASSES,
+                                         GenerationEngine, PagedKVCache,
                                          Request)
 
 __all__ = ["Config", "Predictor", "create_predictor", "DistModel",
            "DistModelConfig", "GenerationEngine", "PagedKVCache",
-           "Request"]
+           "Request", "PRIORITY_CLASSES"]
 
 
 def _stream_micro_batches(forward, ins, mbs, pad_to=1):

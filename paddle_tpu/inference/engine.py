@@ -18,11 +18,34 @@ recompiles:
   there; never allocated).
 - a slot scheduler: `num_slots` decode lanes. Between decode
   iterations, finished requests vacate their lane and queued requests
-  are admitted into free lanes via a bucketed prefill (prompts padded
-  to a small ladder of lengths, so prefill compiles once per BUCKET,
-  not once per prompt length). A lane that cannot get a block this
-  iteration simply skips it (masked to the null block) and retries —
-  graceful degradation under pool pressure instead of an abort.
+  are admitted into free lanes (priority classes first, FIFO within a
+  class). Prefill is CHUNKED by default: each scheduler iteration runs
+  at most ONE fixed-shape compiled prefill chunk, so a long admission
+  interleaves with the in-flight decode batch instead of monopolizing
+  an iteration — and the chunk program compiles ONCE for every prompt
+  length (`start`/`plen` are traced). Passing `prefill_buckets`
+  selects the legacy whole-prompt bucketed prefill, kept as the parity
+  foil CI proves the chunked path token-identical against. A lane that
+  cannot get a block this iteration simply skips it (masked to the
+  null block) and retries — graceful degradation under pool pressure
+  instead of an abort.
+- a prefix cache (chunked mode, on by default): `PagedKVCache` keeps a
+  chain-hash → block map over FULL prompt blocks with per-block
+  refcounts. Admission seats the longest cached block-aligned prefix
+  read-only in the slot's table — hit tokens are never recomputed,
+  only the tail is prefilled. Shared blocks are copy-on-write: a
+  decode write landing in one first promotes it to a private copy via
+  a tiny compiled block-copy step, so token streams stay identical to
+  the uncached path. Cold cached blocks (refcount 0) form an LRU pool
+  that `allocate` evicts from under pressure — the existing
+  stall/retry path, unchanged.
+- admission QoS: `add_request(..., priority=...)` with
+  `PRIORITY_CLASSES` ordering, priority-labeled TTFT/TPOT histograms,
+  and `max_queue` shed-on-saturation (shed requests resolve to None —
+  the HTTP-429 of this API). Priority is STRICT: under sustained
+  higher-class saturation a seated batch lane's prefill can starve —
+  that is the contract (`batch` means "whenever there's room");
+  `max_queue` shedding, not aging, is the overload control.
 - one donated compiled decode step (`jax.jit`, the TrainStep idiom:
   model state threaded as traced args, pools donated so XLA updates
   them in place in HBM): `[slots, 1]` tokens + `[slots]` positions +
@@ -51,10 +74,11 @@ metrics story.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 import os
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -69,16 +93,32 @@ from paddle_tpu.observability.metrics import LATENCY_BUCKETS, \
     MetricsRegistry
 from paddle_tpu.profiler import RecordEvent
 
-__all__ = ["PagedKVCache", "GenerationEngine", "Request"]
+__all__ = ["PagedKVCache", "GenerationEngine", "Request",
+           "PRIORITY_CLASSES"]
 
 
 class PagedKVCache:
-    """Global paged KV pool + host-side block allocator.
+    """Global paged KV pool + host-side block allocator, refcounts, and
+    hash-based prefix cache.
 
     kpool/vpool: `[layers, num_blocks, block_size, heads, head_dim]`
     device arrays, functionally updated by the compiled steps (donated,
     so updated in place on device). Block 0 is reserved as the null
-    block — `allocate` never returns it."""
+    block — `allocate` never returns it.
+
+    Every live block carries a reference count: `allocate` hands blocks
+    out at refcount 1, `share` seats an existing block in another
+    owner's table (+1), `free` decrements and only recycles at zero.
+    The prefix cache is a chain-hash → block-id map over FULL prompt
+    blocks (`register_prefix` publishes them once a prompt's KV is
+    completely written; `match_prefix` walks the chain and takes a
+    reference on every hit). A cached block whose refcount drops to
+    zero is NOT returned to the free list — it parks in an LRU side
+    pool, still addressable by hash, and is only evicted (hash dropped,
+    block recycled) when `allocate` runs out of truly-free blocks. So
+    cache pressure rides the engine's existing stall/retry path: an
+    allocation that fails after eviction is the same stall it always
+    was."""
 
     def __init__(self, num_layers, num_blocks, block_size, num_heads,
                  head_dim, dtype=jnp.float32):
@@ -92,49 +132,181 @@ class PagedKVCache:
         self.vpool = jnp.zeros(shape, dtype)
         # LIFO free list: recently-freed (cache-warm) blocks reused first
         self._free = list(range(num_blocks - 1, 0, -1))
+        self._ref = [0] * self.num_blocks
+        self._ref[0] = 1               # null block: permanently held
+        self._block_of = {}            # chain hash -> cached block id
+        self._hash_of = {}             # cached block id -> chain hash
+        # refcount-zero cached blocks, LRU order (oldest first): the
+        # reclaimable tail of the prefix cache
+        self._evictable = OrderedDict()   # block id -> chain hash
 
     @property
     def num_free(self):
-        return len(self._free)
+        """Blocks allocatable right now: truly free + evictable cached
+        (the prefix cache's reclaimable tail)."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def num_cached_blocks(self):
+        """Blocks the prefix cache can currently serve hits from."""
+        return len(self._block_of)
+
+    def refcount(self, block):
+        return self._ref[block]
 
     def allocate(self, n):
-        """n pool blocks, or None (caller stalls/retries) if the pool
-        is too fragmented-by-occupancy to serve them."""
-        if n > len(self._free):
+        """n pool blocks at refcount 1, or None (caller stalls/retries)
+        if the pool cannot serve them even after evicting every
+        refcount-zero prefix-cache block (LRU first)."""
+        if n > self.num_free:
             return None
-        got = self._free[-n:]
-        del self._free[-n:]
+        take = min(n, len(self._free))
+        got = self._free[-take:] if take else []
+        del self._free[-take:]
+        while len(got) < n:            # reclaim cold cache blocks
+            block, h = self._evictable.popitem(last=False)
+            del self._block_of[h]
+            del self._hash_of[block]
+            got.append(block)
+        for b in got:
+            self._ref[b] = 1
         return got
 
     def free(self, blocks):
-        self._free.extend(blocks)
+        """Drop one reference per block; recycle at refcount zero
+        (cached blocks park in the evictable LRU instead of the free
+        list). Raises on the null block and on double-free — a
+        scheduler bug must fail loudly, not silently double-allocate a
+        live block. Blocks are processed deepest-first so that when a
+        finished request's chain goes cold, LRU eviction reclaims the
+        deepest (least re-usable) links before their parents."""
+        for b in reversed(list(blocks)):
+            b = int(b)
+            if b == 0:
+                raise ValueError("refusing to free the null block 0")
+            if self._ref[b] <= 0:
+                raise RuntimeError(
+                    f"double free of pool block {b} (refcount already "
+                    "0) — a live block would have been handed out twice")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                h = self._hash_of.get(b)
+                if h is None:
+                    self._free.append(b)
+                else:
+                    self._evictable[b] = h   # newest LRU entry
+
+    def share(self, blocks):
+        """Take an extra reference on live blocks (seating them
+        read-only in another slot's table)."""
+        for b in blocks:
+            if self._ref[b] <= 0:
+                raise RuntimeError(f"cannot share dead block {b}")
+            self._ref[b] += 1
+
+    def needs_cow(self, block):
+        """True when writing into `block` would corrupt state another
+        owner (a slot OR the prefix cache) still reads: shared
+        refcount, or registered as cached prefix content."""
+        return self._ref[block] > 1 or block in self._hash_of
+
+    def _chain_hash(self, prev, tokens):
+        return hashlib.blake2b(prev + np.asarray(tokens, np.int32)
+                               .tobytes(), digest_size=16).digest()
+
+    def match_prefix(self, tokens):
+        """Longest cached block-aligned prefix of `tokens`: walks the
+        chain hash over full blocks, takes a reference on every hit
+        (reviving evictable ones), and returns (blocks, hit_tokens).
+        Hit tokens never need recomputing — their KV is already in the
+        pool, byte-for-byte what this prompt's prefill would write."""
+        tokens = np.asarray(tokens, np.int32)
+        bs = self.block_size
+        blocks, h = [], b""
+        for i in range(len(tokens) // bs):
+            h = self._chain_hash(h, tokens[i * bs:(i + 1) * bs])
+            b = self._block_of.get(h)
+            if b is None:
+                break
+            if self._ref[b] == 0:
+                del self._evictable[b]     # revive: live again
+            self._ref[b] += 1
+            blocks.append(b)
+        return blocks, len(blocks) * bs
+
+    def register_prefix(self, tokens, blocks):
+        """Publish a fully-prefilled prompt's FULL blocks into the
+        prefix map (call only once every one of those blocks' KV rows
+        is written). First writer wins: a hash that is already mapped
+        keeps its original block and the racing copy stays private to
+        its slot. Returns the number of blocks newly cached."""
+        tokens = np.asarray(tokens, np.int32)
+        bs = self.block_size
+        added, h = 0, b""
+        for i in range(min(len(tokens) // bs, len(blocks))):
+            h = self._chain_hash(h, tokens[i * bs:(i + 1) * bs])
+            b = int(blocks[i])
+            if h in self._block_of or b in self._hash_of:
+                continue
+            self._block_of[h] = b
+            self._hash_of[b] = h
+            added += 1
+        return added
 
 
-@dataclass
+# admission QoS classes, best-served-first; add_request validates
+# against this tuple and the TTFT/TPOT histograms are labeled by it
+PRIORITY_CLASSES = ("interactive", "standard", "batch")
+
+
+@dataclass(eq=False)
 class Request:
-    """One generation request (prompt in, greedy continuation out)."""
+    """One generation request (prompt in, greedy continuation out).
+    Identity equality (eq=False): the prompt is an ndarray, and two
+    requests with equal content are still distinct requests."""
 
     req_id: object
     prompt: np.ndarray                 # int32 [plen]
     max_new_tokens: int
     eos_token_id: int = None
     arrived_at: float = None           # perf_counter at add_request
+    priority: str = "standard"         # one of PRIORITY_CLASSES
 
 
-@dataclass
+@dataclass(eq=False)
 class _Slot:
-    """A live decode lane: the request plus its paged-cache footprint."""
+    """A live decode lane: the request plus its paged-cache footprint.
+    Identity equality: `self._slots.index(slot)` must find THIS lane,
+    not a content-equal one."""
 
     req: Request
-    blocks: list                       # owned pool block ids, in order
+    blocks: list                       # owned/shared pool block ids
     generated: list = field(default_factory=list)
     last_token_at: float = None        # perf_counter of newest token
+    prefill_pos: int = 0               # next prompt position to prefill
+    hit_tokens: int = 0                # prefix-cache tokens never computed
+    admit_seq: int = 0                 # admission order tiebreak
+
+    @property
+    def prefilling(self):
+        """Still has prompt tokens to push through the chunked
+        prefill (a full-prefix hit skips straight past this)."""
+        return self.prefill_pos < len(self.req.prompt)
 
     @property
     def feed_pos(self):
-        """Absolute position of the token about to be fed (the last
-        generated one — prefill already produced generated[0])."""
+        """Absolute position of the token about to be fed. With
+        `generated` non-empty that is the newest generated token;
+        empty `generated` is the full-prefix-hit state, where the
+        first decode feeds the LAST PROMPT token (its logits produce
+        the first generated token — the one step a full hit cannot
+        skip)."""
         return len(self.req.prompt) + len(self.generated) - 1
+
+    @property
+    def feed_token(self):
+        return self.generated[-1] if self.generated \
+            else int(self.req.prompt[-1])
 
 
 class GenerationEngine:
@@ -155,8 +327,11 @@ class GenerationEngine:
     def __init__(self, model, num_slots=8, block_size=16,
                  num_blocks=None, prefill_buckets=None,
                  max_model_len=None, eos_token_id=None, donate=None,
-                 registry=None, attention_backend=None):
-        from paddle_tpu.ops.paged_attention import resolve_backend
+                 registry=None, attention_backend=None,
+                 prefill_chunk="auto", enable_prefix_cache=None,
+                 max_queue=None):
+        from paddle_tpu.ops.paged_attention import (copy_pool_block,
+                                                    resolve_backend)
 
         cfg = model.config
         if model.training and cfg.dropout > 0:
@@ -172,6 +347,33 @@ class GenerationEngine:
                 f"model's position table ({cfg.max_seq_len})")
         self.max_blocks = math.ceil(self.max_model_len / self.block_size)
         self.eos_token_id = eos_token_id
+        self.max_queue = None if max_queue is None else int(max_queue)
+        # prefill strategy: chunked (default) runs the prompt through a
+        # FIXED-shape compiled chunk step, one chunk per scheduler
+        # iteration — long admissions interleave with decode instead of
+        # monopolizing an iteration, and prefill traces are bounded by
+        # the chunk shape (1), not a bucket ladder. Passing
+        # prefill_buckets (or prefill_chunk=None) selects the legacy
+        # whole-prompt bucketed prefill — kept as the parity foil CI
+        # proves the chunked path token-identical against.
+        if prefill_chunk == "auto":
+            prefill_chunk = None if prefill_buckets is not None \
+                else min(128, self.max_model_len)
+        elif prefill_chunk is not None and prefill_buckets is not None:
+            raise ValueError("prefill_chunk and prefill_buckets are "
+                             "mutually exclusive prefill strategies")
+        self.prefill_chunk = None if prefill_chunk is None \
+            else max(1, min(int(prefill_chunk), self.max_model_len))
+        self.chunked_prefill = self.prefill_chunk is not None
+        # prefix cache: content-hash block reuse needs tail-only
+        # prefill, which only the chunked path can run
+        if enable_prefix_cache is None:
+            enable_prefix_cache = self.chunked_prefill
+        if enable_prefix_cache and not self.chunked_prefill:
+            raise ValueError("the prefix cache needs chunked prefill "
+                             "(bucketed prefill always recomputes from "
+                             "position 0)")
+        self.enable_prefix_cache = bool(enable_prefix_cache)
         # default pool covers every slot at full context (+ null block):
         # correctness-first; serving deployments size it to live-context
         # expectations and lean on the stall/retry path under pressure
@@ -181,12 +383,16 @@ class GenerationEngine:
             self.block_size, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads,
             dtype=model.gpt.wte.weight._array.dtype)
-        self.prefill_buckets = tuple(sorted(
-            prefill_buckets or self._default_buckets()))
-        if self.prefill_buckets[-1] < self.max_model_len:
-            raise ValueError("largest prefill bucket "
-                             f"({self.prefill_buckets[-1]}) must cover "
-                             f"max_model_len={self.max_model_len}")
+        if self.chunked_prefill:
+            self.prefill_buckets = ()
+        else:
+            self.prefill_buckets = tuple(sorted(
+                prefill_buckets or self._default_buckets()))
+            if self.prefill_buckets[-1] < self.max_model_len:
+                raise ValueError("largest prefill bucket "
+                                 f"({self.prefill_buckets[-1]}) must "
+                                 "cover max_model_len="
+                                 f"{self.max_model_len}")
         # paged-attention kernel backend: constructor arg, overridden by
         # the env (deploy-time switch without a code change), resolved
         # ONCE to a concrete backend so the compiled decode step is
@@ -206,14 +412,25 @@ class GenerationEngine:
         self._decode_pure = count_traces(self._build_decode())
         self._decode = jax.jit(self._decode_pure,
                                donate_argnums=(1, 2) if donate else ())
-        self._prefill_pure = count_traces(self._build_prefill())
+        self._prefill_pure = count_traces(
+            self._build_prefill_chunk() if self.chunked_prefill
+            else self._build_prefill())
         self._prefill = jax.jit(self._prefill_pure,
                                 donate_argnums=(1, 2) if donate else ())
-        self._queue = deque()
+        # copy-on-write promotion: one tiny compiled gather/scatter,
+        # traced src/dst so every COW reuses the same program
+        cow = count_traces(copy_pool_block)
+        cow.__name__ = "engine_cow_copy"
+        self._cow_pure = cow
+        self._cow = jax.jit(cow,
+                            donate_argnums=(0, 1) if donate else ())
+        self._queues = {p: deque() for p in PRIORITY_CLASSES}
         self._slots = [None] * self.num_slots
         self._results = {}
         self._auto_id = 0
+        self._admit_counter = 0
         self.tokens_generated = 0
+        self.prefix_hit_tokens = 0
         # serving telemetry: per-engine registry by default so counter
         # exactness survives multiple engines in one process; pass
         # observability.get_registry() to publish on the process default
@@ -226,13 +443,17 @@ class GenerationEngine:
         self._m_ttft = m.histogram(
             "engine_ttft_seconds",
             "Request arrival to first generated token (includes queue "
-            "wait and prefill).", buckets=LATENCY_BUCKETS)
+            "wait and prefill), labeled by QoS priority class.",
+            labelnames=("priority",), buckets=LATENCY_BUCKETS)
         self._m_tpot = m.histogram(
             "engine_tpot_seconds",
-            "Per-output-token latency: time since the slot's PREVIOUS "
-            "token, so block-stall waits show up (not just the "
-            "producing iteration's wall time).",
-            buckets=LATENCY_BUCKETS)
+            "Per-output-token latency, labeled by QoS priority class: "
+            "time since the slot's PREVIOUS token, so block-stall "
+            "waits show up (not just the producing iteration's wall "
+            "time). A request that only ever produces one token "
+            "records that token's producing-step latency instead of "
+            "staying invisible.",
+            labelnames=("priority",), buckets=LATENCY_BUCKETS)
         self._m_queue = m.gauge(
             "engine_queue_depth", "Requests waiting for a slot.")
         self._m_active = m.gauge(
@@ -261,7 +482,28 @@ class GenerationEngine:
             "Times the decode step traced (steady-state contract: 1).")
         self._m_prefill_traces = m.gauge(
             "engine_prefill_traces",
-            "Times prefill traced (bounded by len(prefill_buckets)).")
+            "Times prefill traced (chunked: bounded by the one chunk "
+            "shape; bucketed: by len(prefill_buckets)).")
+        self._m_prefill_chunks = m.counter(
+            "engine_prefill_chunks_total",
+            "Compiled prefill-chunk dispatches (prefix-cache hits "
+            "shrink this: hit tokens skip prefill compute).")
+        self._m_hit_tokens = m.counter(
+            "engine_prefix_cache_hit_tokens_total",
+            "Prompt tokens served from the prefix cache instead of "
+            "being recomputed.")
+        self._m_cached_blocks = m.gauge(
+            "engine_prefix_cached_blocks",
+            "Pool blocks the prefix cache can currently serve hits "
+            "from (live + evictable).")
+        self._m_cow = m.counter(
+            "engine_cow_copies_total",
+            "Copy-on-write block promotions: a decode write landed in "
+            "a shared/cached block and got a private copy first.")
+        self._m_shed = m.counter(
+            "engine_shed_total",
+            "Requests shed at saturation (max_queue exceeded), by "
+            "priority class.", labelnames=("priority",))
         self._m_recompiles = m.counter(
             "engine_decode_recompiles_total",
             "Decode retraces past the first compile — nonzero means a "
@@ -282,10 +524,13 @@ class GenerationEngine:
         self._decode_traces_seen = 0
 
     def _update_pool_gauges(self):
+        # "used" = referenced blocks; refcount-zero cached blocks are
+        # reclaimable on demand, so they count as free capacity
         used = self.cache.num_blocks - 1 - self.cache.num_free
         self._m_pool_used.set(used)
         self._m_pool_util.set(used / max(self.cache.num_blocks - 1, 1))
         self._m_pool_hw.set_max(used)
+        self._m_cached_blocks.set(self.cache.num_cached_blocks)
 
     def _sample_traces(self):
         """Mirror the count_traces probes into metrics; a decode trace
@@ -357,6 +602,34 @@ class GenerationEngine:
         prefill_fn.__name__ = "engine_prefill"
         return prefill_fn
 
+    def _build_prefill_chunk(self):
+        model, state = self.model, self._state
+        C = self.prefill_chunk
+
+        def prefill_chunk_fn(state_arrays, kpool, vpool, tokens, start,
+                             plen, table_row):
+            # tokens [1, C] FIXED; start/plen traced -> ONE program
+            # serves every chunk of every prompt length
+            with bound_state(zip(state, state_arrays), state):
+                hidden, kp, vp = model.gpt.forward_prefill_chunk(
+                    Tensor._wrap(tokens), Tensor._wrap(start),
+                    Tensor._wrap(kpool), Tensor._wrap(vpool),
+                    Tensor._wrap(table_row), Tensor._wrap(plen))
+                # the LAST REAL prompt position's logits yield the
+                # first generated token; it lives in the final chunk —
+                # for earlier chunks the one-hot selects nothing and
+                # the host ignores the returned token
+                sel = (start + jnp.arange(C) == plen - 1) \
+                    .astype(hidden._array.dtype)
+                h_last = (hidden._array * sel[None, :, None]) \
+                    .sum(axis=1, keepdims=True)
+                logits = model._logits_of(Tensor._wrap(h_last))
+                nxt = jnp.argmax(logits._array[0, 0]).astype(jnp.int32)
+                return nxt, kp._array, vp._array
+
+        prefill_chunk_fn.__name__ = "engine_prefill_chunk"
+        return prefill_chunk_fn
+
     # -- recompile probes (CI contract) ------------------------------------
     @property
     def decode_traces(self):
@@ -371,14 +644,23 @@ class GenerationEngine:
 
     # -- request intake ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    req_id=None):
+                    req_id=None, priority="standard"):
         """Queue a request; admitted into a free slot between decode
-        iterations (may be called while `run`/`step` is mid-stream)."""
+        iterations (may be called while `run`/`step` is mid-stream).
+        `priority` is one of PRIORITY_CLASSES — higher classes admit
+        first and survive saturation shedding longer. With `max_queue`
+        set and the queue full, the lowest-priority loser is shed: its
+        result is recorded as None (the HTTP-429 of this API) and
+        `engine_shed_total` counts it; the request kept is whichever
+        of (incoming, worst queued) ranks higher."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if priority not in PRIORITY_CLASSES:
+            raise ValueError(f"priority must be one of "
+                             f"{PRIORITY_CLASSES}, got {priority!r}")
         total = prompt.size + int(max_new_tokens)
         if total > self.max_model_len:
             raise ValueError(
@@ -394,10 +676,33 @@ class GenerationEngine:
             raise ValueError(f"req_id {req_id!r} is already queued, "
                              "decoding, or awaiting collection")
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
-        self._queue.append(Request(req_id, prompt, int(max_new_tokens),
-                                   eos, arrived_at=time.perf_counter()))
-        self._m_queue.set(len(self._queue))
+        req = Request(req_id, prompt, int(max_new_tokens), eos,
+                      arrived_at=time.perf_counter(), priority=priority)
+        if self.max_queue is not None \
+                and self.num_pending >= self.max_queue:
+            victim = self._shed_victim(priority)
+            if victim is None:         # incoming ranks no better: shed it
+                self._shed(req)
+                return req_id
+            self._shed(victim)
+        self._queues[priority].append(req)
+        self._m_queue.set(self.num_pending)
         return req_id
+
+    def _shed_victim(self, incoming_priority):
+        """Worst queued request STRICTLY below the incoming class
+        (newest within it — it has waited least), or None when the
+        incoming request is the one to shed."""
+        rank = PRIORITY_CLASSES.index(incoming_priority)
+        for p in reversed(PRIORITY_CLASSES[rank + 1:]):
+            if self._queues[p]:
+                return self._queues[p].pop()
+        return None
+
+    def _shed(self, req):
+        self._results[req.req_id] = None
+        self._m_shed.labels(priority=req.priority).inc()
+        self._m_queue.set(self.num_pending)
 
     # -- scheduler ---------------------------------------------------------
     def _bucket_for(self, plen):
@@ -413,10 +718,23 @@ class GenerationEngine:
     def _in_flight(self):
         """Ids that would collide with a new request: queued, seated in
         a lane, or finished but not yet drained by run()."""
-        ids = {r.req_id for r in self._queue}
+        ids = {r.req_id for p in PRIORITY_CLASSES
+               for r in self._queues[p]}
         ids.update(s.req.req_id for s in self._slots if s is not None)
         ids.update(self._results)
         return ids
+
+    def _peek_request(self):
+        for p in PRIORITY_CLASSES:
+            if self._queues[p]:
+                return self._queues[p][0]
+        return None
+
+    def _pop_request(self):
+        req = self._peek_request()
+        if req is not None:
+            self._queues[req.priority].popleft()
+        return req
 
     def _finish(self, slot, reason):
         req = slot.req
@@ -425,13 +743,125 @@ class GenerationEngine:
         self.cache.free(slot.blocks)
         self._m_finished.labels(reason=reason).inc()
 
-    def _admit(self):
-        """Fill free lanes from the queue (FIFO): allocate the prompt's
-        blocks, run the bucketed prefill (writes KV into the blocks,
-        yields the first generated token), seat the slot."""
+    def _first_token(self, slot, first, t_step):
+        """Seat a request's FIRST generated token (from the final
+        prefill chunk or the whole-prompt bucketed prefill): TTFT,
+        token accounting, prefix-cache publication, and instant-finish
+        retirement. Returns False when the slot finished on the spot
+        (its lane has been vacated)."""
+        req = slot.req
+        now = time.perf_counter()
+        slot.generated.append(first)
+        slot.last_token_at = now
+        self.tokens_generated += 1
+        self._m_tokens.inc()
+        if req.arrived_at is not None:
+            self._m_ttft.labels(priority=req.priority).observe(
+                now - req.arrived_at)
+        if self.enable_prefix_cache:
+            # the prompt's KV is now fully written: publish its FULL
+            # blocks for future admissions to seat read-only
+            self.cache.register_prefix(req.prompt, slot.blocks)
+        done_eos = (req.eos_token_id is not None
+                    and first == req.eos_token_id)
+        if done_eos or req.max_new_tokens == 1:
+            # instant finisher: its only token would otherwise be
+            # invisible to the TPOT histogram while still counting in
+            # engine_tokens_generated_total — record the producing
+            # step's latency explicitly
+            self._m_tpot.labels(priority=req.priority).observe(
+                now - t_step)
+            self._finish(slot, "eos" if done_eos else "length")
+            self._slots[self._slots.index(slot)] = None
+            return False
+        return True
+
+    # -- admission: chunked (default) --------------------------------------
+    def _admit_chunked(self):
+        """Seat queued requests (priority order, FIFO within a class)
+        into free lanes: match the longest cached block-aligned prefix,
+        take read-only references on those blocks, and leave the tail
+        for the incremental chunk prefill. No compute happens here —
+        a full-prefix hit enters decode directly (feeding the last
+        prompt token; copy-on-write keeps its write private)."""
         admitted = 0
-        while self._queue and None in self._slots:
-            req = self._queue[0]
+        while None in self._slots:
+            req = self._pop_request()
+            if req is None:
+                break
+            blocks, hit = [], 0
+            if self.enable_prefix_cache:
+                blocks, hit = self.cache.match_prefix(req.prompt)
+                if hit:
+                    self.prefix_hit_tokens += hit
+                    self._m_hit_tokens.inc(hit)
+            slot = _Slot(req=req, blocks=list(blocks), prefill_pos=hit,
+                         hit_tokens=hit, admit_seq=self._admit_counter)
+            self._admit_counter += 1
+            self._slots[self._slots.index(None)] = slot
+            self._m_admissions.inc()
+            self._update_pool_gauges()
+            admitted += 1
+        self._m_queue.set(self.num_pending)
+        return admitted
+
+    def _prefill_step(self):
+        """Run at most ONE compiled prefill chunk: pick the neediest
+        prefilling lane (priority, then admission order), allocate the
+        chunk's blocks (evicting cold cache blocks if necessary), and
+        push `prefill_chunk` prompt positions through the fixed-shape
+        chunk program. The final chunk yields the first generated
+        token. A lane that cannot get blocks stalls and the next
+        candidate gets the chunk."""
+        cands = [s for s in self._slots
+                 if s is not None and s.prefilling]
+        cands.sort(key=lambda s: (
+            PRIORITY_CLASSES.index(s.req.priority), s.admit_seq))
+        C = self.prefill_chunk
+        for slot in cands:
+            req = slot.req
+            plen = int(req.prompt.size)
+            start = slot.prefill_pos
+            end = min(start + C, plen)
+            need = math.ceil(end / self.block_size) - len(slot.blocks)
+            if need > 0:
+                got = self.cache.allocate(need)
+                if got is None:
+                    self._m_stalls.labels(path="prefill").inc()
+                    continue           # pool pressure: next candidate
+                slot.blocks.extend(got)
+                self._update_pool_gauges()
+            tokens = np.zeros((1, C), np.int32)
+            tokens[0, :end - start] = req.prompt[start:end]
+            row = np.zeros(self.max_blocks, np.int32)
+            row[:len(slot.blocks)] = slot.blocks
+            with RecordEvent("engine.prefill"):
+                t0 = time.perf_counter()
+                nxt, self.cache.kpool, self.cache.vpool = \
+                    self._prefill(
+                        self._state_arrays(), self.cache.kpool,
+                        self.cache.vpool, jnp.asarray(tokens),
+                        jnp.int32(start), jnp.int32(plen),
+                        jnp.asarray(row))
+                self._m_prefill_chunks.inc()
+                slot.prefill_pos = end
+                if end < plen:         # mid-prompt: no sync needed
+                    return 1
+                first = int(nxt)       # sync: first token is out
+            self._first_token(slot, first, t0)
+            return 1
+        return 0
+
+    # -- admission: legacy whole-prompt bucketed prefill -------------------
+    def _admit(self):
+        """Fill free lanes from the queue (priority order): allocate
+        the prompt's blocks, run the bucketed prefill (writes KV into
+        the blocks, yields the first generated token), seat the slot."""
+        admitted = 0
+        while None in self._slots:
+            req = self._peek_request()
+            if req is None:
+                break
             plen = int(req.prompt.size)
             need = math.ceil(plen / self.block_size)
             blocks = self.cache.allocate(need)
@@ -439,108 +869,141 @@ class GenerationEngine:
                 self._m_stalls.labels(path="admit").inc()
                 break                      # pool pressure: retry later
             self._update_pool_gauges()     # high-water sees the peak
-            self._queue.popleft()
+            self._pop_request()
             bucket = self._bucket_for(plen)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :plen] = req.prompt
             row = np.zeros(self.max_blocks, np.int32)
             row[:need] = blocks
+            slot = _Slot(req=req, blocks=blocks, prefill_pos=plen,
+                         admit_seq=self._admit_counter)
+            self._admit_counter += 1
+            self._slots[self._slots.index(None)] = slot
+            self._m_admissions.inc()
+            admitted += 1
             with RecordEvent("engine.prefill"):
+                t0 = time.perf_counter()
                 first, self.cache.kpool, self.cache.vpool = \
                     self._prefill(
                         self._state_arrays(), self.cache.kpool,
                         self.cache.vpool, jnp.asarray(tokens),
                         jnp.int32(plen), jnp.asarray(row))
                 first = int(first)         # sync: first token is out
-            slot = _Slot(req=req, blocks=blocks, generated=[first],
-                         last_token_at=time.perf_counter())
-            self.tokens_generated += 1
-            self._m_tokens.inc()
-            self._m_admissions.inc()
-            if req.arrived_at is not None:
-                self._m_ttft.observe(time.perf_counter() -
-                                     req.arrived_at)
-            admitted += 1
-            if (req.eos_token_id is not None
-                    and slot.generated[-1] == req.eos_token_id):
-                self._finish(slot, "eos")  # instant EOS
-                continue
-            if req.max_new_tokens == 1:
-                self._finish(slot, "length")   # one-token request
-                continue
-            self._slots[self._slots.index(None)] = slot
-        self._m_queue.set(len(self._queue))
+            self._first_token(slot, first, t0)
+        self._m_queue.set(self.num_pending)
         return admitted
 
-    def step(self):
-        """One scheduler iteration: admit, then one batched decode step
-        over every lane that holds a block for its write position.
-        Returns the number of lanes+admissions that made progress."""
-        with RecordEvent("engine.step"):
-            progressed = self._admit()
-            runnable = []
-            for i, slot in enumerate(self._slots):
-                if slot is None:
+    # -- decode ------------------------------------------------------------
+    def _decode_step(self):
+        """One batched decode step over every decode-phase lane that
+        holds an exclusively-writable block for its write position.
+        Copy-on-write happens here: a lane whose feed position sits in
+        a shared or prefix-cached block first gets a private copy via
+        the compiled block-copy step."""
+        runnable = []
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.prefilling:
+                continue
+            bi = slot.feed_pos // self.block_size
+            if bi >= len(slot.blocks):
+                # on-demand growth: the feed position opens a new block
+                got = self.cache.allocate(1)
+                if got is None:
+                    self._m_stalls.labels(path="decode").inc()
+                    continue           # stalled this iteration
+                slot.blocks.extend(got)
+                self._update_pool_gauges()
+            elif self.cache.needs_cow(slot.blocks[bi]):
+                # the write position sits in a block other owners (or
+                # the prefix cache) still read — promote to a private
+                # copy so the shared KV stays byte-identical for them
+                got = self.cache.allocate(1)
+                if got is None:
+                    self._m_stalls.labels(path="decode").inc()
                     continue
-                # on-demand growth: the feed position may open a new
-                # block
-                bi = slot.feed_pos // self.block_size
-                if bi >= len(slot.blocks):
-                    got = self.cache.allocate(1)
-                    if got is None:
-                        self._m_stalls.labels(path="decode").inc()
-                        continue           # stalled this iteration
-                    slot.blocks.extend(got)
-                    self._update_pool_gauges()
-                runnable.append(i)
-            if not runnable:
-                self._end_of_step_gauges()
-                return progressed
-            tokens = np.zeros((self.num_slots, 1), np.int32)
-            positions = np.zeros(self.num_slots, np.int32)
-            tables = np.zeros((self.num_slots, self.max_blocks),
-                              np.int32)
-            for i in runnable:
-                slot = self._slots[i]
-                tokens[i, 0] = slot.generated[-1]
-                positions[i] = slot.feed_pos
-                tables[i, :len(slot.blocks)] = slot.blocks
-            with RecordEvent("engine.decode"):
-                t_dec = time.perf_counter()
-                nxt, self.cache.kpool, self.cache.vpool = self._decode(
-                    self._state_arrays(), self.cache.kpool,
-                    self.cache.vpool, jnp.asarray(tokens),
-                    jnp.asarray(positions), jnp.asarray(tables))
-                nxt = np.asarray(nxt)      # sync: tokens are out
-                self._m_decode_seconds.observe(
-                    time.perf_counter() - t_dec)
-            now = time.perf_counter()
-            for i in runnable:
-                slot = self._slots[i]
-                tok = int(nxt[i])
-                slot.generated.append(tok)
-                self.tokens_generated += 1
-                self._m_tokens.inc()
+                src, dst = slot.blocks[bi], got[0]
+                with RecordEvent("engine.cow"):
+                    self.cache.kpool, self.cache.vpool = self._cow(
+                        self.cache.kpool, self.cache.vpool,
+                        jnp.int32(src), jnp.int32(dst))
+                self.cache.free([src])     # drop our shared reference
+                slot.blocks[bi] = dst
+                self._m_cow.inc()
+                self._update_pool_gauges()
+            runnable.append(i)
+        if not runnable:
+            return 0
+        tokens = np.zeros((self.num_slots, 1), np.int32)
+        positions = np.zeros(self.num_slots, np.int32)
+        tables = np.zeros((self.num_slots, self.max_blocks),
+                          np.int32)
+        for i in runnable:
+            slot = self._slots[i]
+            tokens[i, 0] = slot.feed_token
+            positions[i] = slot.feed_pos
+            tables[i, :len(slot.blocks)] = slot.blocks
+        with RecordEvent("engine.decode"):
+            t_dec = time.perf_counter()
+            nxt, self.cache.kpool, self.cache.vpool = self._decode(
+                self._state_arrays(), self.cache.kpool,
+                self.cache.vpool, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(tables))
+            nxt = np.asarray(nxt)      # sync: tokens are out
+            self._m_decode_seconds.observe(
+                time.perf_counter() - t_dec)
+        now = time.perf_counter()
+        for i in runnable:
+            slot = self._slots[i]
+            tok = int(nxt[i])
+            is_first = not slot.generated    # full-prefix-hit lane
+            slot.generated.append(tok)
+            self.tokens_generated += 1
+            self._m_tokens.inc()
+            req = slot.req
+            if is_first:
+                # this decode produced the request's FIRST token (its
+                # whole prompt came from the prefix cache)
+                if req.arrived_at is not None:
+                    self._m_ttft.labels(priority=req.priority).observe(
+                        now - req.arrived_at)
+            elif slot.last_token_at is not None:
                 # inter-token latency per SLOT, not this iteration's
                 # wall time: a lane that sat out N stalled iterations
                 # reports the (N+1)-iteration gap its user experienced
-                if slot.last_token_at is not None:
-                    self._m_tpot.observe(now - slot.last_token_at)
-                slot.last_token_at = now
-                req = slot.req
-                if req.eos_token_id is not None \
-                        and tok == req.eos_token_id:
-                    self._finish(slot, "eos")
-                    self._slots[i] = None
-                elif len(slot.generated) >= req.max_new_tokens:
-                    self._finish(slot, "length")
-                    self._slots[i] = None
+                self._m_tpot.labels(priority=req.priority).observe(
+                    now - slot.last_token_at)
+            slot.last_token_at = now
+            done_eos = req.eos_token_id is not None \
+                and tok == req.eos_token_id
+            if done_eos or len(slot.generated) >= req.max_new_tokens:
+                if is_first:
+                    # single-token request: its only token still lands
+                    # in the TPOT histogram (producing-step latency)
+                    self._m_tpot.labels(
+                        priority=req.priority).observe(now - t_dec)
+                self._finish(slot, "eos" if done_eos else "length")
+                self._slots[i] = None
+        return len(runnable)
+
+    def step(self):
+        """One scheduler iteration: admit queued requests into free
+        lanes, run AT MOST one prefill chunk (chunked mode — long
+        prompts never monopolize an iteration), then one batched decode
+        step over every decode-phase lane. Returns the number of
+        admissions/chunks/lanes that made progress."""
+        with RecordEvent("engine.step"):
+            if self.chunked_prefill:
+                progressed = self._admit_chunked()
+                progressed += self._prefill_step()
+            else:
+                progressed = self._admit()
+            progressed += self._decode_step()
             self._end_of_step_gauges()
-            return progressed + len(runnable)
+            return progressed
 
     def _end_of_step_gauges(self):
         self._m_active.set(self.num_active)
-        self._m_queue.set(len(self._queue))
+        self._m_queue.set(self.num_pending)
         self._update_pool_gauges()
         self._sample_traces()
 
@@ -550,19 +1013,30 @@ class GenerationEngine:
 
     @property
     def num_pending(self):
-        return len(self._queue)
+        return sum(len(self._queues[p]) for p in PRIORITY_CLASSES)
 
     def run(self):
         """Drive until every queued/admitted request finished; returns
-        (and drains) {req_id: prompt + generated tokens}."""
-        while self._queue or self.num_active:
+        (and drains) {req_id: prompt + generated tokens; None for a
+        request shed at saturation}."""
+        while self.num_pending or self.num_active:
             if self.step() == 0:
-                need = math.ceil(self._queue[0].prompt.size /
-                                 self.block_size) if self._queue else 1
+                req = self._peek_request()
+                if req is not None:
+                    blocker = ("no admission fits (next request needs "
+                               f"{math.ceil(req.prompt.size / self.block_size)}"
+                               " blocks)")
+                else:
+                    stalled = sum(s is not None and s.prefilling
+                                  for s in self._slots)
+                    blocker = (f"{stalled} lane(s) stalled in prefill "
+                               f"and {self.num_active - stalled} in "
+                               "decode growth/copy-on-write, all "
+                               "waiting on a block")
                 raise RuntimeError(
-                    "generation engine deadlocked: no lane could get a "
-                    f"block and no admission fits ({self.cache.num_free}"
-                    f" free blocks, next request needs {need}) — grow "
-                    "num_blocks or shrink num_slots/max_model_len")
+                    "generation engine deadlocked: "
+                    f"{blocker} with {self.cache.num_free} free blocks "
+                    "— grow num_blocks or shrink "
+                    "num_slots/max_model_len")
         out, self._results = self._results, {}
         return out

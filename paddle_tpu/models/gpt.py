@@ -124,6 +124,24 @@ class GPTAttention(nn.Layer):
             q, k, v, is_causal=True, dropout_p=0.0, training=False)
         return self.out_proj(mp.reshape(out, [B, S, H])), k, v
 
+    def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
+                              block_row, start, plen):
+        """Chunked prefill for ONE slot against the paged pool: write
+        this chunk's k/v through the slot's block table and attend the
+        chunk's queries over the whole context so far (shared prefix
+        blocks included, read-only). x [1,C,H]; start/plen traced
+        scalars — one compiled program per chunk WIDTH, not per prompt
+        length. Returns (out [1,C,H], new_kpool, new_vpool)."""
+        from paddle_tpu.ops.paged_attention import paged_prefill_chunk
+
+        B, C, H = x.shape  # B == 1
+        qkv = self.qkv_proj(x)
+        qkv = mp.reshape(qkv, [B, C, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)
+        out, kpool, vpool = paged_prefill_chunk(
+            q, k, v, kpool, vpool, layer_idx, block_row, start, plen)
+        return self.out_proj(mp.reshape(out, [B, C, H])), kpool, vpool
+
     def forward_decode(self, x, kcache, vcache, pos):
         """One-token decode against a FIXED-size cache (the jit-friendly
         KV cache: no growing concat). x [B,1,H]; kcache/vcache
@@ -229,6 +247,14 @@ class GPTBlock(nn.Layer):
         x = x + a
         return x + self.mlp(self.ln2(x)), k, v
 
+    def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
+                              block_row, start, plen):
+        a, kpool, vpool = self.attn.forward_prefill_chunk(
+            self.ln1(x), kpool, vpool, layer_idx, block_row, start,
+            plen)
+        x = x + a
+        return x + self.mlp(self.ln2(x)), kpool, vpool
+
     def forward_decode(self, x, kcache, vcache, pos):
         a, kcache, vcache = self.attn.forward_decode(self.ln1(x),
                                                      kcache, vcache,
@@ -283,6 +309,31 @@ class GPTModel(nn.Layer):
             ks.append(k)
             vs.append(v)
         return self.ln_f(h), mp.stack(ks, axis=0), mp.stack(vs, axis=0)
+
+    def forward_prefill_chunk(self, token_ids, start, kpool, vpool,
+                              block_row, plen):
+        """Chunked paged prefill (the engine's incremental admission
+        path): token_ids [1,C] — chunk `[start, start+C)` of one
+        slot's prompt, padded past `plen`; kpool/vpool the global
+        paged pools; block_row [max_blocks] the slot's table. Writes
+        the chunk's per-layer KV through the table and returns
+        (hidden [1,C,H], new_kpool, new_vpool). `start`/`plen` are
+        traced — ONE compiled program serves every chunk of every
+        prompt, so prefill trace count is bounded by the chunk shape,
+        not a bucket ladder."""
+        B, C = token_ids.shape
+        pos_t = start.astype("int32") if hasattr(start, "astype") \
+            else paddle.to_tensor(start, dtype="int32")
+        # clamp padded-tail positions into the wpe table: their rows
+        # are garbage the engine ignores, but the gather must stay in
+        # bounds for any (start, chunk) combination
+        pos_vec = paddle.clip(pos_t + paddle.arange(C, dtype="int32"),
+                              0, self.config.max_seq_len - 1)
+        h = self.wte(token_ids) + self.wpe(pos_vec).unsqueeze(0)
+        for i, blk in enumerate(self.blocks):
+            h, kpool, vpool = blk.forward_prefill_chunk(
+                h, kpool, vpool, i, block_row, pos_t, plen)
+        return self.ln_f(h), kpool, vpool
 
     def forward_decode(self, token_ids, pos, kstack, vstack):
         """One decode step: token_ids [B,1], pos scalar (may be traced)

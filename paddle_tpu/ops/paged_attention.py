@@ -32,6 +32,19 @@ fp32; the PV product accumulates in fp32 (`preferred_element_type`)
 and the output is cast to q.dtype ONCE at the end — a bf16 pool loses
 only the matmul-input rounding, not the accumulation.
 
+Prefix-cache sharing (PR 6): with the engine's prefix cache on, several
+slots' block tables may point at the SAME pool block (a shared system
+prompt computed once). Both decode backends tolerate that by
+construction — context blocks are only ever READ through the table, and
+the step's single write lands at the slot's own feed position, which
+the engine guarantees sits in an exclusively-owned block (copy-on-write
+promotes a shared block to a private copy via `copy_pool_block` before
+any write could touch it). `paged_prefill_chunk` is the incremental
+prefill step that makes tail-only prefill possible: it writes one
+fixed-shape chunk of prompt KV and attends the chunk's queries over
+everything the slot's table covers so far — including read-only shared
+prefix blocks another request prefilled.
+
 Implementation notes:
 - functional `.at[].set` / aliased-pool writes chain through the layer
   stack; under the engine's donated compiled step XLA aliases them in
@@ -50,6 +63,7 @@ import jax.numpy as jnp
 from .dispatch import apply, as_tensor
 
 __all__ = ["paged_attention_step", "paged_prefill_write",
+           "paged_prefill_chunk", "copy_pool_block",
            "dense_gather_reference", "resolve_backend",
            "PAGED_BACKENDS", "PAGED_PATH_STATS"]
 
@@ -225,6 +239,102 @@ def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):
 
     return apply("paged_prefill_write", fn, kpool, vpool, kstack, vstack,
                  block_row, plen)
+
+
+def paged_prefill_chunk(q, k, v, kpool, vpool, layer, block_row, start,
+                        plen, scale=None):
+    """One chunked-prefill step for ONE slot, for one layer: write the
+    chunk's k/v into the pool, then attend the chunk's queries over the
+    slot's whole context so far (shared prefix blocks + earlier chunks
+    + the chunk itself, causally).
+
+    q/k/v: `[1, C, heads, head_dim]` — this chunk's projections; C is
+    the FIXED chunk width, so one compiled program serves every prompt
+    length (`start` and `plen` are traced scalars).
+    block_row: `[max_blocks]` int32 — the slot's block table.
+    start: absolute position of the chunk's first token.
+    plen: true prompt length. Chunk positions >= plen (tail padding)
+    write to the null block 0 and their query outputs are garbage the
+    caller ignores (same contract as bucketed prefill padding).
+
+    Work is O(chunk x context-so-far) via the same traced-trip-count
+    `fori_loop` online softmax as the dense decode step — identical
+    numerics policy (fp32 logits/softmax state, fp32 PV accumulation,
+    one cast at the end). Reads may cross blocks OTHER slots own (the
+    prefix cache seats them read-only); writes never do — the chunk's
+    write blocks were allocated exclusively to this slot. Returns
+    `(out [1, C, heads, head_dim], new_kpool, new_vpool)`."""
+    q, k, v = as_tensor(q), as_tensor(k), as_tensor(v)
+    kpool, vpool = as_tensor(kpool), as_tensor(vpool)
+    block_row = as_tensor(block_row)
+    start, plen = as_tensor(start), as_tensor(plen)
+
+    def fn(qa, ka, va, kp, vp, row, s0, n):
+        C = qa.shape[1]
+        heads, d = qa.shape[2], qa.shape[3]
+        bs = kp.shape[2]
+        maxb = row.shape[0]
+        pos = s0 + jnp.arange(C)                       # absolute [C]
+        valid = pos < n
+        bid = jnp.where(valid,
+                        row[jnp.minimum(pos // bs, maxb - 1)], 0)
+        off = pos % bs
+        kp = kp.at[layer, bid, off].set(ka[0])         # [C, heads, d]
+        vp = vp.at[layer, bid, off].set(va[0])
+        s = scale if scale is not None else 1.0 / np.sqrt(d)
+        # QK at pool dtype, fp32 accumulation — the _dense_step policy,
+        # so chunked and bucketed prefill see the same rounding story
+        qf = qa[0].astype(kp.dtype)                    # [C, heads, d]
+        end = jnp.minimum(s0 + C, n)                   # past-last pos
+        hw_blocks = jnp.maximum(end - 1, 0) // bs + 1  # traced scalar
+
+        def body(j, carry):
+            m, l, acc = carry
+            b = row[j]
+            keys = kp[layer, b]                        # [bs, heads, d]
+            vals = vp[layer, b]
+            logits = jnp.einsum(
+                "chd,khd->hck", qf, keys,
+                preferred_element_type=jnp.float32) * s
+            # causal over absolute positions: key j*bs+k visible to
+            # query c iff it is at or before the query's position
+            allowed = (j * bs + jnp.arange(bs))[None, :] <= pos[:, None]
+            logits = jnp.where(allowed[None, :, :], logits, -1e30)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1,
+                                           keepdims=True))
+            p = jnp.exp(logits - m_new)                # [heads, C, bs]
+            alpha = jnp.exp(m - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jnp.einsum("hck,khd->hcd", p.astype(vals.dtype), vals,
+                            preferred_element_type=jnp.float32)
+            return m_new, l_new, acc * alpha + pv
+
+        m0 = jnp.full((heads, C, 1), -1e30, jnp.float32)
+        l0 = jnp.zeros((heads, C, 1), jnp.float32)
+        acc0 = jnp.zeros((heads, C, d), jnp.float32)
+        _, l, acc = jax.lax.fori_loop(0, hw_blocks, body, (m0, l0, acc0))
+        out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)
+        return out.transpose(1, 0, 2)[None], kp, vp    # [1,C,heads,d]
+
+    return apply("paged_prefill_chunk", fn, q, k, v, kpool, vpool,
+                 block_row, start, plen)
+
+
+def copy_pool_block(kpool, vpool, src, dst):
+    """Copy one block's KV rows across every layer plane: the engine's
+    copy-on-write step. `src`/`dst` may be traced scalars, so the
+    engine compiles this ONCE and reuses it for every COW promotion
+    (donated pools: XLA rewrites the dst rows in place in HBM). Raw
+    jnp arrays in/out — this is a compiled-step body, not a user op."""
+    srows = jax.lax.dynamic_index_in_dim(kpool, src, axis=1,
+                                         keepdims=False)
+    kpool = jax.lax.dynamic_update_index_in_dim(kpool, srows, dst,
+                                                axis=1)
+    srows = jax.lax.dynamic_index_in_dim(vpool, src, axis=1,
+                                         keepdims=False)
+    vpool = jax.lax.dynamic_update_index_in_dim(vpool, srows, dst,
+                                                axis=1)
+    return kpool, vpool
 
 
 def dense_gather_reference(kpool, vpool, layer, block_row, length):

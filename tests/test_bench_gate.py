@@ -172,7 +172,8 @@ def test_pending_smoke_flags_unadopted_opbench_rows():
     assert res.returncode == 0, res.stdout + res.stderr  # report-only
     for row in ("gpt_decode_kv_350m", "gpt_engine_offered_load",
                 "paged_attention_decode_sweep",
-                "gpt_engine_offered_load_pallas"):
+                "gpt_engine_offered_load_pallas",
+                "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill"):
         assert f"PENDING: {row}" in res.stdout, res.stdout
     assert "pending row(s) not gated" in res.stdout
     # --strict turns the report into a failure

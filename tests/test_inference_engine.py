@@ -387,4 +387,5 @@ def test_engine_pool_pressure_stall_counter(model):
     # the engine registry speaks prometheus end-to-end
     text = eng.metrics.render_prometheus()
     assert "engine_block_stalls_total{path=" in text
-    assert "engine_ttft_seconds_bucket{le=" in text
+    # TTFT is priority-labeled since the QoS tier; buckets append `le`
+    assert 'engine_ttft_seconds_bucket{priority="standard",le=' in text

@@ -135,6 +135,60 @@ def test_block_table_edge_cases(backend):
     assert np.abs(vp[0, 1:8]).max() < 100.0
 
 
+@pytest.mark.parametrize("backend", ["dense", "pallas"])
+def test_shared_prefix_blocks_read_only_in_both_backends(backend):
+    """The prefix-cache layout: two slots whose tables alias the SAME
+    context blocks (a shared system prompt seated read-only) but own
+    private write blocks — the post-COW invariant the engine
+    guarantees. Both backends must (a) compute each slot's attention
+    over the shared context exactly, and (b) leave the shared blocks'
+    bytes untouched: the step's only writes land in each slot's own
+    block."""
+    from paddle_tpu.ops.paged_attention import (
+        dense_gather_reference, paged_attention_step)
+
+    bs, maxb, H, D = 4, 4, 2, 8
+    nb = 12
+    rng = np.random.RandomState(13)
+    shared_blocks = [1, 2]              # 8 shared prefix tokens
+    tables = np.zeros((2, maxb), np.int32)
+    tables[0, :3] = shared_blocks + [3]   # slot 0 writes into block 3
+    tables[1, :3] = shared_blocks + [4]   # slot 1 into block 4
+    positions = np.asarray([8, 8], np.int32)   # both at the boundary
+
+    kpool = np.zeros((1, nb, bs, H, D), np.float32)
+    vpool = np.zeros((1, nb, bs, H, D), np.float32)
+    ctx_k = rng.randn(2 * bs, H, D).astype(np.float32)
+    ctx_v = rng.randn(2 * bs, H, D).astype(np.float32)
+    for t in range(2 * bs):
+        kpool[0, shared_blocks[t // bs], t % bs] = ctx_k[t]
+        vpool[0, shared_blocks[t // bs], t % bs] = ctx_v[t]
+    shared_k0 = kpool[0, shared_blocks].copy()
+    shared_v0 = vpool[0, shared_blocks].copy()
+
+    q = rng.randn(2, 1, H, D).astype(np.float32)
+    k_new = rng.randn(2, 1, H, D).astype(np.float32)
+    v_new = rng.randn(2, 1, H, D).astype(np.float32)
+    out, kp, vp = paged_attention_step(q, k_new, v_new, kpool, vpool,
+                                       0, tables, positions,
+                                       backend=backend)
+    out = np.asarray(out._array)
+    kp, vp = np.asarray(kp._array), np.asarray(vp._array)
+
+    ctx = np.broadcast_to(ctx_k, (2,) + ctx_k.shape)
+    ctxv = np.broadcast_to(ctx_v, (2,) + ctx_v.shape)
+    for b in range(2):
+        ref = _np_step_reference(q[b], k_new[b], v_new[b], ctx[b],
+                                 ctxv[b], 8)
+        np.testing.assert_allclose(out[b], ref, rtol=2e-4, atol=2e-5)
+        gk, gv = dense_gather_reference(kp, vp, 0, tables[b], 9)
+        np.testing.assert_allclose(gk[-1], k_new[b, 0], rtol=1e-6)
+        np.testing.assert_allclose(gv[-1], v_new[b, 0], rtol=1e-6)
+    # the aliased context blocks are byte-identical to before the step
+    np.testing.assert_array_equal(kp[0, shared_blocks], shared_k0)
+    np.testing.assert_array_equal(vp[0, shared_blocks], shared_v0)
+
+
 def test_backends_agree_bitwise_on_pool_writes():
     """The two backends must produce the SAME pool bytes (writes are
     scatter-vs-DMA of identical rows) and outputs within float

@@ -22,10 +22,11 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.inference.engine import (PRIORITY_CLASSES,
                                          GenerationEngine, PagedKVCache,
                                          Request)
+from paddle_tpu.inference.speculative import NgramDrafter
 
 __all__ = ["Config", "Predictor", "create_predictor", "DistModel",
            "DistModelConfig", "GenerationEngine", "PagedKVCache",
-           "Request", "PRIORITY_CLASSES"]
+           "Request", "PRIORITY_CLASSES", "NgramDrafter"]
 
 
 def _stream_micro_batches(forward, ins, mbs, pad_to=1):

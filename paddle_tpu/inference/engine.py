@@ -63,6 +63,33 @@ published as the `engine_attention_backend_info` gauge and every decode
 dispatch lands in the backend-labeled `engine_decode_step_seconds`
 histogram.
 
+Speculative decoding (PR 7): decode is HBM-bandwidth-bound (every
+step re-reads the weights and the live KV), so the engine can amortize
+one target-model pass over several tokens: with `spec_decode_k=K > 0`
+(env override `PADDLE_SPEC_DECODE_K`), a host-side DRAFTER
+(`inference/speculative.NgramDrafter` by default — model-free
+prompt-lookup; any `propose(prompt, generated, k)` object plugs in)
+proposes up to K tokens per lane, and ONE fixed-shape compiled verify
+step (`forward_verify_paged`: `[slots, K+1]` tokens, traced per-row
+positions and draft lengths) scores all K+1 positions against the
+paged pools, writing their KV through the block tables. Acceptance is
+EXACT under the greedy contract: the longest draft prefix matching the
+target's own argmax is emitted (plus the target's next token — every
+verify step nets >= 1 token), so output streams are token-identical
+to the non-speculative engine for ANY drafter. Rejected positions
+need no cleanup — the slot position simply does not advance past
+them, position-bounded attention makes their stale KV unreachable,
+and the next window overwrites them. Writes landing in shared or
+prefix-cached blocks COW-promote first, for EVERY block the window
+touches, exactly like plain decode. Per-lane variable acceptance
+stays inside one program via masking, so `decode_traces == 1` holds
+per (backend, K); K=0 builds today's decode step unchanged
+(bit-for-bit the same program). Multi-token steps keep the latency
+books honest: every accepted token lands in the TPOT histogram
+against its producing step (the step gap amortized per token), and
+`engine_spec_accepted_tokens` / `engine_spec_draft_hit_rate` track
+how much the drafter is actually buying.
+
 Serving telemetry (PR 2): every engine carries a metrics registry
 (`engine.metrics`, observability tier) — TTFT/TPOT histograms, queue/
 slot/pool gauges with a high-water mark, admission/finish/stall
@@ -329,7 +356,7 @@ class GenerationEngine:
                  max_model_len=None, eos_token_id=None, donate=None,
                  registry=None, attention_backend=None,
                  prefill_chunk="auto", enable_prefix_cache=None,
-                 max_queue=None):
+                 max_queue=None, spec_decode_k=0, drafter=None):
         from paddle_tpu.ops.paged_attention import (copy_pool_block,
                                                     resolve_backend)
 
@@ -403,13 +430,39 @@ class GenerationEngine:
         self.attention_backend = resolve_backend(
             requested, head_dim=cfg.hidden_size // cfg.num_heads,
             block_size=self.block_size)
+        # speculative decoding: K drafted tokens verified per compiled
+        # step. Env override wins (deploy-time knob, like the backend);
+        # K=0 builds today's one-token decode step unchanged.
+        env_k = os.environ.get("PADDLE_SPEC_DECODE_K")
+        if env_k not in (None, ""):
+            try:
+                k = int(env_k)
+            except ValueError:
+                raise ValueError(
+                    f"PADDLE_SPEC_DECODE_K={env_k!r} is not an integer")
+        else:
+            k = int(spec_decode_k)
+        if k < 0:
+            raise ValueError(f"spec_decode_k must be >= 0, got {k}")
+        self.spec_decode_k = k
+        if k > 0:
+            from paddle_tpu.inference.speculative import NgramDrafter
+
+            self.drafter = drafter if drafter is not None \
+                else NgramDrafter()
+        else:
+            self.drafter = None
         # the state threading of TrainStep: params+buffers ride as traced
         # args, so weight updates are visible without retracing
         self._state = dedup_params(list(model.parameters())) + \
             model_buffers(model)
         donate = (jax.default_backend() != "cpu") if donate is None \
             else donate
-        self._decode_pure = count_traces(self._build_decode())
+        # with speculation on, the verify step IS the engine's decode
+        # step: same probe, same donation, same traces==1 contract —
+        # one program per (backend, K)
+        self._decode_pure = count_traces(
+            self._build_verify() if k > 0 else self._build_decode())
         self._decode = jax.jit(self._decode_pure,
                                donate_argnums=(1, 2) if donate else ())
         self._prefill_pure = count_traces(
@@ -466,7 +519,9 @@ class GenerationEngine:
         self._m_stalls = m.counter(
             "engine_block_stalls_total",
             "Iterations a lane/admission skipped for want of a pool "
-            "block.", labelnames=("path",))
+            "block (path=spec_degrade: a speculative lane shed its "
+            "draft window instead of skipping).",
+            labelnames=("path",))
         self._m_tokens = m.counter(
             "engine_tokens_generated_total", "New tokens emitted.")
         self._m_pool_used = m.gauge(
@@ -504,6 +559,27 @@ class GenerationEngine:
             "engine_shed_total",
             "Requests shed at saturation (max_queue exceeded), by "
             "priority class.", labelnames=("priority",))
+        self._m_spec_accepted = m.histogram(
+            "engine_spec_accepted_tokens",
+            "Tokens emitted per speculative verify step per lane "
+            "(1 = no draft token survived; K+1 = the whole window "
+            "accepted).",
+            buckets=(1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0, 16.0))
+        self._m_spec_hit_rate = m.gauge(
+            "engine_spec_draft_hit_rate",
+            "Fraction of drafted tokens the target model confirmed "
+            "(exact-acceptance matches / proposals) since the last "
+            "registry reset.")
+        # hit-rate numerator/denominator live IN the registry so a
+        # metrics.reset() (bench warmup, per-window scrapes) restarts
+        # the rate instead of averaging over all-time
+        spec_drafted = m.counter(
+            "engine_spec_draft_tokens_total",
+            "Drafted tokens offered to the verify step, by whether "
+            "the target's argmax confirmed them.",
+            labelnames=("result",))
+        self._m_spec_ok = spec_drafted.labels(result="accepted")
+        self._m_spec_rej = spec_drafted.labels(result="rejected")
         self._m_recompiles = m.counter(
             "engine_decode_recompiles_total",
             "Decode retraces past the first compile — nonzero means a "
@@ -574,6 +650,30 @@ class GenerationEngine:
 
         decode_fn.__name__ = "engine_decode_step"
         return decode_fn
+
+    def _build_verify(self):
+        """The speculative decode step: one fixed `[slots, K+1]` window
+        scores the feed token plus up to K drafts per lane in a single
+        target-model pass. Per-row positions and draft lengths are
+        traced, so every acceptance outcome reuses ONE program."""
+        model, state = self.model, self._state
+        backend = self.attention_backend
+
+        def verify_fn(state_arrays, kpool, vpool, tokens, positions,
+                      dlens, tables):
+            with bound_state(zip(state, state_arrays), state):
+                h, kp, vp = model.gpt.forward_verify_paged(
+                    Tensor._wrap(tokens), Tensor._wrap(positions),
+                    Tensor._wrap(dlens), Tensor._wrap(kpool),
+                    Tensor._wrap(vpool), Tensor._wrap(tables),
+                    backend=backend)
+                logits = model._logits_of(h)     # [slots, K+1, V]
+                nxt = jnp.argmax(logits._array, axis=-1) \
+                    .astype(jnp.int32)
+                return nxt, kp._array, vp._array
+
+        verify_fn.__name__ = "engine_verify_step"
+        return verify_fn
 
     def _build_prefill(self):
         from paddle_tpu.ops.paged_attention import paged_prefill_write
@@ -894,12 +994,37 @@ class GenerationEngine:
         return admitted
 
     # -- decode ------------------------------------------------------------
+    def _cow_promote(self, slot, bi, count_stall=True):
+        """Give `slot` a private copy of its table entry `bi` via the
+        compiled block-copy step (the write is about to land there and
+        other owners — slots or the prefix cache — still read it).
+        Returns False when the pool cannot serve the copy (caller
+        stalls the lane this iteration; `count_stall=False` when the
+        caller has a degrade path and the lane may still run)."""
+        got = self.cache.allocate(1)
+        if got is None:
+            if count_stall:
+                self._m_stalls.labels(path="decode").inc()
+            return False
+        src, dst = slot.blocks[bi], got[0]
+        with RecordEvent("engine.cow"):
+            self.cache.kpool, self.cache.vpool = self._cow(
+                self.cache.kpool, self.cache.vpool,
+                jnp.int32(src), jnp.int32(dst))
+        self.cache.free([src])         # drop our shared reference
+        slot.blocks[bi] = dst
+        self._m_cow.inc()
+        self._update_pool_gauges()
+        return True
+
     def _decode_step(self):
         """One batched decode step over every decode-phase lane that
         holds an exclusively-writable block for its write position.
         Copy-on-write happens here: a lane whose feed position sits in
         a shared or prefix-cached block first gets a private copy via
         the compiled block-copy step."""
+        if self.spec_decode_k:
+            return self._spec_decode_step()
         runnable = []
         for i, slot in enumerate(self._slots):
             if slot is None or slot.prefilling:
@@ -917,19 +1042,8 @@ class GenerationEngine:
                 # the write position sits in a block other owners (or
                 # the prefix cache) still read — promote to a private
                 # copy so the shared KV stays byte-identical for them
-                got = self.cache.allocate(1)
-                if got is None:
-                    self._m_stalls.labels(path="decode").inc()
-                    continue
-                src, dst = slot.blocks[bi], got[0]
-                with RecordEvent("engine.cow"):
-                    self.cache.kpool, self.cache.vpool = self._cow(
-                        self.cache.kpool, self.cache.vpool,
-                        jnp.int32(src), jnp.int32(dst))
-                self.cache.free([src])     # drop our shared reference
-                slot.blocks[bi] = dst
-                self._m_cow.inc()
-                self._update_pool_gauges()
+                if not self._cow_promote(slot, bi):
+                    continue           # pool pressure: stalled
             runnable.append(i)
         if not runnable:
             return 0
@@ -979,6 +1093,184 @@ class GenerationEngine:
                 if is_first:
                     # single-token request: its only token still lands
                     # in the TPOT histogram (producing-step latency)
+                    self._m_tpot.labels(
+                        priority=req.priority).observe(now - t_dec)
+                self._finish(slot, "eos" if done_eos else "length")
+                self._slots[i] = None
+        return len(runnable)
+
+    def _spec_decode_step(self):
+        """One speculative verify step: draft up to K tokens per
+        decode-phase lane (host-side, between compiled steps), grow
+        and COW-protect every block the `[feed_pos, feed_pos+k]` write
+        window touches, score all K+1 positions in ONE compiled pass,
+        and emit the longest draft prefix the target's argmax confirms
+        plus the target's own next token. Rejection is rollback by
+        position: the lane simply does not advance past the accepted
+        prefix, so the rejected rows' KV is unreachable (attention is
+        position-bounded) until the next window overwrites it. A lane
+        that cannot get blocks for its window degrades to a draftless
+        (plain-decode) window before it stalls."""
+        K = self.spec_decode_k
+        W = K + 1
+        bs = self.block_size
+        vocab = self.model.config.vocab_size
+        runnable, drafts = [], {}
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.prefilling:
+                continue
+            req = slot.req
+            # window budget: emitted tokens cap at the request's
+            # remaining allowance, and the last write position must
+            # stay inside the model's length
+            budget = min(K,
+                         req.max_new_tokens - len(slot.generated) - 1,
+                         self.max_model_len - 1 - slot.feed_pos)
+            draft = []
+            if budget > 0:
+                for t in self.drafter.propose(req.prompt,
+                                              slot.generated, budget):
+                    t = int(t)
+                    if not 0 <= t < vocab or len(draft) >= budget:
+                        break          # junk proposal: verify nothing
+                    draft.append(t)
+            # grow the table to cover the window's last write; under
+            # pool pressure shed the draft (plain one-token window)
+            # before stalling the lane outright
+            stalled = False
+            while True:
+                need = (slot.feed_pos + len(draft)) // bs + 1 \
+                    - len(slot.blocks)
+                if need <= 0:
+                    break
+                got = self.cache.allocate(need)
+                if got is not None:
+                    slot.blocks.extend(got)
+                    self._update_pool_gauges()
+                    break
+                if not draft:
+                    self._m_stalls.labels(path="decode").inc()
+                    stalled = True
+                    break
+                draft = []             # degrade: draftless step
+                self._m_stalls.labels(path="spec_degrade").inc()
+            if stalled:
+                continue
+            # copy-on-write over EVERY block the window writes into —
+            # a speculative write must never land in a block other
+            # owners (or the prefix cache) still read
+            def cow_window(k_len, count_stall):
+                for bi in range(slot.feed_pos // bs,
+                                (slot.feed_pos + k_len) // bs + 1):
+                    if self.cache.needs_cow(slot.blocks[bi]) \
+                            and not self._cow_promote(
+                                slot, bi, count_stall=count_stall):
+                        return False
+                return True
+
+            if not cow_window(len(draft), count_stall=False):
+                # pool pressure mid-window: shed the draft AND the
+                # surplus tail blocks past the feed block (always
+                # private — they only ever held rejected rows), so
+                # the pool gets them back, then retry the plain
+                # one-token window. Without this a lane could sit on
+                # window blocks while stalling on the COW copy —
+                # deadlocking pools where the K=0 engine progresses.
+                # The degrade is its own stall flavor: the lane still
+                # RUNS, so it must not read as a skipped iteration.
+                feed_bi = slot.feed_pos // bs
+                surplus = slot.blocks[feed_bi + 1:]
+                if surplus:
+                    del slot.blocks[feed_bi + 1:]
+                    self.cache.free(surplus)
+                    self._update_pool_gauges()
+                if draft:
+                    draft = []
+                    self._m_stalls.labels(path="spec_degrade").inc()
+                if not cow_window(0, count_stall=True):
+                    continue           # truly stalled this iteration
+            drafts[i] = draft
+            runnable.append(i)
+        if not runnable:
+            return 0
+        tokens = np.zeros((self.num_slots, W), np.int32)
+        positions = np.zeros(self.num_slots, np.int32)
+        dlens = np.zeros(self.num_slots, np.int32)
+        tables = np.zeros((self.num_slots, self.max_blocks), np.int32)
+        for i in runnable:
+            slot = self._slots[i]
+            d = drafts[i]
+            tokens[i, 0] = slot.feed_token
+            if d:
+                tokens[i, 1:1 + len(d)] = d
+            positions[i] = slot.feed_pos
+            dlens[i] = len(d)
+            tables[i, :len(slot.blocks)] = slot.blocks
+        with RecordEvent("engine.decode"):
+            t_dec = time.perf_counter()
+            nxt, self.cache.kpool, self.cache.vpool = self._decode(
+                self._state_arrays(), self.cache.kpool,
+                self.cache.vpool, jnp.asarray(tokens),
+                jnp.asarray(positions), jnp.asarray(dlens),
+                jnp.asarray(tables))
+            nxt = np.asarray(nxt)      # sync: [slots, K+1] argmaxes
+            self._m_decode_seconds.observe(
+                time.perf_counter() - t_dec)
+        now = time.perf_counter()
+        for i in runnable:
+            slot = self._slots[i]
+            req = slot.req
+            out, d = nxt[i], drafts[i]
+            # exact greedy acceptance: the target's own next token,
+            # then every draft token that EQUALS the target's argmax
+            # at its position (each match validates the next column)
+            acc = [int(out[0])]
+            for j, dj in enumerate(d):
+                if dj != int(out[j]):
+                    break
+                acc.append(int(out[j + 1]))
+            self._m_spec_ok.inc(len(acc) - 1)
+            self._m_spec_rej.inc(len(d) - (len(acc) - 1))
+            # EOS / length truncation: emit stops AT the first stop
+            # token, exactly like the one-token path would have
+            emit = []
+            for t in acc:
+                emit.append(t)
+                if (req.eos_token_id is not None
+                        and t == req.eos_token_id) \
+                        or len(slot.generated) + len(emit) \
+                        >= req.max_new_tokens:
+                    break
+            m_tok = len(emit)
+            is_first = not slot.generated      # full-prefix-hit lane
+            slot.generated.extend(emit)
+            self.tokens_generated += m_tok
+            self._m_tokens.inc(m_tok)
+            self._m_spec_accepted.observe(m_tok)
+            proposed = self._m_spec_ok.value + self._m_spec_rej.value
+            if proposed:
+                self._m_spec_hit_rate.set(
+                    self._m_spec_ok.value / proposed)
+            if is_first and req.arrived_at is not None:
+                self._m_ttft.labels(priority=req.priority).observe(
+                    now - req.arrived_at)
+            # multi-token latency accounting: every accepted token is
+            # recorded against its producing step — the lane's step
+            # gap amortized per token, so TPOT sums still integrate
+            # to wall time and m_tok=1 degenerates to the plain path
+            gap = now - (t_dec if is_first or slot.last_token_at is None
+                         else slot.last_token_at)
+            n_tpot = m_tok - 1 if is_first else m_tok
+            for _ in range(n_tpot):
+                self._m_tpot.labels(priority=req.priority).observe(
+                    gap / m_tok)
+            slot.last_token_at = now
+            done_eos = req.eos_token_id is not None \
+                and emit[-1] == req.eos_token_id
+            if done_eos or len(slot.generated) >= req.max_new_tokens:
+                if is_first and n_tpot == 0:
+                    # single-token instant finisher: keep it visible
+                    # (the PR-6 TPOT contract)
                     self._m_tpot.labels(
                         priority=req.priority).observe(now - t_dec)
                 self._finish(slot, "eos" if done_eos else "length")

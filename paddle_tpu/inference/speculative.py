@@ -1,0 +1,82 @@
+"""Drafters for speculative decoding on the generation engine.
+
+Speculative decoding amortizes the HBM-bandwidth-bound decode step
+(weights + KV read once per target-model pass) over several tokens: a
+cheap DRAFTER proposes up to K continuation tokens per lane, the
+engine scores all K+1 positions in ONE compiled verify pass
+(`GPTModel.forward_verify_paged`), and the longest draft prefix whose
+tokens equal the target's own argmax is accepted. Because the engine
+decodes greedily, acceptance is EXACT: the emitted stream is
+token-identical to the non-speculative path whatever the drafter
+proposes — a bad draft only costs wasted verify columns, never a wrong
+token.
+
+The drafter contract (the seam a tiny draft GPT plugs into):
+
+    drafter.propose(prompt, generated, k) -> sequence of <= k ints
+
+- `prompt` is the request's int32 prompt array, `generated` the list
+  of tokens emitted so far (host-side concrete values — the drafter
+  runs between compiled steps and must never trace);
+- return up to `k` proposed continuation tokens (fewer, or empty, is
+  always legal — the engine falls back to a plain one-token step);
+- proposals are suggestions only: correctness never depends on them.
+
+`NgramDrafter` is the shipped model-free baseline (prompt-lookup /
+n-gram matching, as in "Prompt Lookup Decoding" and the Leviathan et
+al. (2023) model-free discussion): it matches the lane's most recent
+n-gram against its own earlier context (prompt + generated tokens) and
+proposes the continuation that followed the latest previous
+occurrence. Summarization/code/chat workloads repeat long spans of
+their prompt, so this hits often at zero draft-model cost. A learned
+drafter (e.g. a tiny GPT sharing the tokenizer) implements the same
+protocol — typically `argmax`-decoding `k` tokens from
+`prompt + generated` — and drops in via `GenerationEngine(...,
+drafter=...)`.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["NgramDrafter"]
+
+
+class NgramDrafter:
+    """Model-free prompt-lookup drafter.
+
+    Tries the longest n-gram first (`max_ngram` down to `min_ngram`):
+    take the lane's last n tokens, find the most recent EARLIER
+    occurrence of that n-gram in the lane's context, and propose the
+    tokens that followed it. No proposal when nothing matches — the
+    engine then runs a plain one-token step for that lane.
+    """
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        if min_ngram < 1:
+            raise ValueError("min_ngram must be >= 1")
+        if max_ngram < min_ngram:
+            raise ValueError("max_ngram must be >= min_ngram")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, prompt, generated, k):
+        if k <= 0:
+            return []
+        ctx = np.asarray(prompt, np.int64).reshape(-1)
+        if len(generated):
+            ctx = np.concatenate(
+                [ctx, np.asarray(list(generated), np.int64)])
+        L = len(ctx)
+        # n is capped so a match can still offer >= 1 continuation
+        for n in range(min(self.max_ngram, L - 1),
+                       self.min_ngram - 1, -1):
+            pat = ctx[L - n:]
+            win = np.lib.stride_tricks.sliding_window_view(ctx, n)
+            starts = np.nonzero((win == pat).all(axis=1))[0]
+            # drop matches with no room for a continuation token —
+            # including the query suffix itself (start == L - n)
+            starts = starts[starts <= L - n - 1]
+            if starts.size:
+                s0 = int(starts[-1])           # most recent occurrence
+                return [int(t) for t in ctx[s0 + n:s0 + n + k]]
+        return []

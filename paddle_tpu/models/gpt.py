@@ -195,6 +195,29 @@ class GPTAttention(nn.Layer):
             backend=backend)
         return self.out_proj(mp.reshape(out, [B, 1, H])), kpool, vpool
 
+    def forward_verify_paged(self, x, kpool, vpool, layer_idx,
+                             block_tables, positions, draft_lens,
+                             backend="auto"):
+        """Speculative K-token verify over the GLOBAL paged pool: one
+        fixed `[slots, W]` window per lane (W = K+1: the feed token
+        plus the drafts). x [slots,W,H]; positions [slots] absolute
+        position of window row 0 per slot; draft_lens [slots] live-row
+        count minus one (rows past it write the null block). Writes
+        every live row's k/v through the table and attends each window
+        query causally up to its own position — the target model
+        scores all W candidate positions in one pass. Returns
+        (out [slots,W,H], new_kpool, new_vpool)."""
+        from paddle_tpu.ops.paged_attention import paged_verify_window
+
+        B, W, H = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = mp.reshape(qkv, [B, W, 3, self.num_heads, self.head_dim])
+        q, k, v = mp.unbind(qkv, axis=2)
+        out, kpool, vpool = paged_verify_window(
+            q, k, v, kpool, vpool, layer_idx, block_tables, positions,
+            draft_lens, backend=backend)
+        return self.out_proj(mp.reshape(out, [B, W, H])), kpool, vpool
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, config: GPTConfig):
@@ -267,6 +290,15 @@ class GPTBlock(nn.Layer):
         a, kpool, vpool = self.attn.forward_decode_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
             positions, backend=backend)
+        x = x + a
+        return x + self.mlp(self.ln2(x)), kpool, vpool
+
+    def forward_verify_paged(self, x, kpool, vpool, layer_idx,
+                             block_tables, positions, draft_lens,
+                             backend="auto"):
+        a, kpool, vpool = self.attn.forward_verify_paged(
+            self.ln1(x), kpool, vpool, layer_idx, block_tables,
+            positions, draft_lens, backend=backend)
         x = x + a
         return x + self.mlp(self.ln2(x)), kpool, vpool
 
@@ -373,6 +405,41 @@ class GPTModel(nn.Layer):
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_decode_paged(
                 h, kpool, vpool, i, block_tables, pos_t,
+                backend=backend)
+        return self.ln_f(h), kpool, vpool
+
+    def forward_verify_paged(self, token_ids, positions, draft_lens,
+                             kpool, vpool, block_tables,
+                             backend="auto"):
+        """Speculative verify step over the paged pool (the engine's
+        K-token decode): token_ids [slots, W] — the feed token plus up
+        to W-1 drafted tokens per lane, positions [slots] int32 row-0
+        absolute positions, draft_lens [slots] int32 live-row bounds
+        (both traced — ONE compiled program per (backend, W) serves
+        every draft/acceptance mix), kpool/vpool the global pools,
+        block_tables [slots, max_blocks]. Returns
+        (hidden [slots, W, H], new_kpool, new_vpool) — the hidden at
+        every window row, so the caller argmaxes all W candidate
+        continuations from one pass."""
+        B, W = token_ids.shape
+        pos_t = positions.astype("int32") \
+            if hasattr(positions, "astype") \
+            else paddle.to_tensor(positions, dtype="int32")
+        dlen_t = draft_lens.astype("int32") \
+            if hasattr(draft_lens, "astype") \
+            else paddle.to_tensor(draft_lens, dtype="int32")
+        # absolute position per window row, clipped into the wpe table:
+        # dead rows past a slot's draft length may run beyond the
+        # model's positions — their rows are garbage the engine
+        # ignores, but the gather must stay in bounds
+        wpos = paddle.clip(
+            pos_t.unsqueeze(1)
+            + paddle.arange(W, dtype="int32").unsqueeze(0),
+            0, self.config.max_seq_len - 1)            # [B, W]
+        h = self.wte(token_ids) + self.wpe(wpos)
+        for i, blk in enumerate(self.blocks):
+            h, kpool, vpool = blk.forward_verify_paged(
+                h, kpool, vpool, i, block_tables, pos_t, dlen_t,
                 backend=backend)
         return self.ln_f(h), kpool, vpool
 

@@ -45,6 +45,23 @@ fixed-shape chunk of prompt KV and attends the chunk's queries over
 everything the slot's table covers so far — including read-only shared
 prefix blocks another request prefilled.
 
+Speculative decoding (PR 7): `paged_verify_window` is the K-token
+verify step's attention — a fixed `[slots, K+1]` window per decode
+lane (the feed token plus up to K drafted tokens), per-row base
+positions and draft lengths both traced, so ONE compiled program
+serves every acceptance outcome. Window row `i` of slot `b` lives at
+absolute position `positions[b] + i` and is LIVE iff
+`i <= draft_lens[b]`; live rows write their k/v through the slot's
+block table (the engine COW-promotes every block the window touches
+first), dead rows (draft shorter than K, idle lanes) write the null
+block. Each window query attends causally over the slot's context up
+to its own position — so the target model scores all K+1 positions in
+one pass, and rejected tokens need no cleanup: the engine simply does
+not advance the slot position past them, and position-bounded masking
+makes their stale KV rows unreachable until overwritten. Dispatches
+through the same backend seam (`dense` fori-loop fallback /
+`pallas` fused kernel, interpreter-run off-TPU).
+
 Implementation notes:
 - functional `.at[].set` / aliased-pool writes chain through the layer
   stack; under the engine's donated compiled step XLA aliases them in
@@ -62,10 +79,10 @@ import jax.numpy as jnp
 
 from .dispatch import apply, as_tensor
 
-__all__ = ["paged_attention_step", "paged_prefill_write",
-           "paged_prefill_chunk", "copy_pool_block",
-           "dense_gather_reference", "resolve_backend",
-           "PAGED_BACKENDS", "PAGED_PATH_STATS"]
+__all__ = ["paged_attention_step", "paged_verify_window",
+           "paged_prefill_write", "paged_prefill_chunk",
+           "copy_pool_block", "dense_gather_reference",
+           "resolve_backend", "PAGED_BACKENDS", "PAGED_PATH_STATS"]
 
 PAGED_BACKENDS = ("auto", "dense", "pallas")
 
@@ -208,6 +225,112 @@ def _dense_step(qa, ka, va, kp, vp, layer, bt, pos, scale):
     _, l, acc = jax.lax.fori_loop(0, hw_blocks, body, (m0, l0, acc0))
     out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)  # cast ONCE
     return out[:, None], kp, vp
+
+
+def paged_verify_window(q, k, v, kpool, vpool, layer, block_tables,
+                        positions, draft_lens, scale=None,
+                        backend="auto"):
+    """Speculative-verify attention over a fixed `[slots, W]` token
+    window (W = K+1), for one layer.
+
+    q/k/v: `[slots, W, heads, head_dim]` — the window's projections
+    (feed token at row 0, drafted tokens after it).
+    kpool/vpool: `[layers, num_blocks, block_size, heads, head_dim]`.
+    layer: python int (static).
+    block_tables: `[slots, max_blocks]` int32 pool-block ids per slot.
+    positions: `[slots]` int32 — absolute position of window row 0
+    (the slot's feed position).
+    draft_lens: `[slots]` int32 in `[0, W-1]` — row `i` is live iff
+    `i <= draft_lens[s]`; dead rows write the null block and their
+    outputs are garbage the engine ignores.
+
+    Live rows write k/v at `(table[(pos+i)//bs], (pos+i)%bs)`; every
+    query attends causally over context `<= pos+i`. A draft_len of 0
+    degenerates to `paged_attention_step` semantics on row 0 (the
+    engine's draftless fallback under pool pressure). Idle lanes are
+    (position 0, draft_len 0, all-null table), exactly the decode
+    contract. Returns `(out [slots, W, heads, head_dim], new_kpool,
+    new_vpool)`."""
+    q, k, v = as_tensor(q), as_tensor(k), as_tensor(v)
+    kpool, vpool = as_tensor(kpool), as_tensor(vpool)
+    block_tables = as_tensor(block_tables)
+    positions, draft_lens = as_tensor(positions), as_tensor(draft_lens)
+
+    resolved = resolve_backend(backend, head_dim=q.shape[3],
+                               block_size=kpool.shape[2])
+    PAGED_PATH_STATS[resolved] += 1
+    if resolved == "pallas":
+        from .pallas.paged_attention import paged_verify_attention
+
+        interpret = not _on_tpu()
+
+        def fn(qa, ka, va, kp, vp, bt, pos, dlen):
+            return paged_verify_attention(qa, ka, va, kp, vp, layer,
+                                          bt, pos, dlen, scale=scale,
+                                          interpret=interpret)
+    else:
+        def fn(qa, ka, va, kp, vp, bt, pos, dlen):
+            return _dense_verify(qa, ka, va, kp, vp, layer, bt, pos,
+                                 dlen, scale)
+
+    return apply("paged_verify_window", fn, q, k, v, kpool, vpool,
+                 block_tables, positions, draft_lens)
+
+
+def _dense_verify(qa, ka, va, kp, vp, layer, bt, pos, dlen, scale):
+    """XLA fallback for the verify window: the `_dense_step` online
+    softmax widened to W queries per slot. Work per step is
+    O(max(pos + dlen)) — the batch high-water mark including the
+    window — with the traced trip count keeping one program for every
+    (position, draft-length) mix."""
+    B, W = qa.shape[0], qa.shape[1]
+    heads, d = qa.shape[2], qa.shape[3]
+    bs = kp.shape[2]
+    maxb = bt.shape[1]
+    wpos = pos[:, None] + jnp.arange(W)[None, :]       # [B, W] absolute
+    live = jnp.arange(W)[None, :] <= dlen[:, None]     # [B, W]
+    # dead rows (and any clamp overflow) land in the null block 0; the
+    # table index is clamped so a dead row past the table stays in
+    # bounds before the where() routes it to null
+    bid = jnp.where(
+        live, jnp.take_along_axis(bt, jnp.minimum(wpos // bs, maxb - 1),
+                                  axis=1), 0)
+    off = wpos % bs
+    kp = kp.at[layer, bid, off].set(ka)                # [B, W, heads, d]
+    vp = vp.at[layer, bid, off].set(va)
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    # QK at the pool dtype with fp32 accumulation — the _dense_step
+    # policy, so verify and plain decode share one rounding story
+    qf = qa.astype(kp.dtype)                           # [B, W, heads, d]
+    hw_blocks = jnp.max(pos + dlen) // bs + 1          # traced scalar
+
+    def body(j, carry):
+        m, l, acc = carry
+        bidj = jax.lax.dynamic_index_in_dim(bt, j, axis=1,
+                                            keepdims=False)    # [B]
+        keys = kp[layer, bidj]                   # [B, bs, heads, d]
+        vals = vp[layer, bidj]
+        logits = jnp.einsum("bwhd,bkhd->bhwk", qf, keys,
+                            preferred_element_type=jnp.float32) * s
+        # causal per window row: key j*bs+k visible to window query w
+        # iff it sits at or before that query's absolute position
+        allowed = (j * bs + jnp.arange(bs))[None, None, :] \
+            <= wpos[:, :, None]                  # [B, W, bs]
+        logits = jnp.where(allowed[:, None, :, :], logits, -1e30)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)              # [B, heads, W, bs] f32
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhwk,bkhd->bhwd", p.astype(vals.dtype), vals,
+                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((B, heads, W, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, heads, W, 1), jnp.float32)
+    acc0 = jnp.zeros((B, heads, W, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, hw_blocks, body, (m0, l0, acc0))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)  # cast ONCE
+    return out.transpose(0, 2, 1, 3), kp, vp       # [B, W, heads, d]
 
 
 def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):

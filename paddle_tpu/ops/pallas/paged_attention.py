@@ -24,10 +24,21 @@ table) writes its garbage row into block 0 and attends only position 0
 read a trailing-zero table entry because the walk stops at
 `pos // block_size`.
 
-Interpret mode (`interpret=True`) runs the same kernel through the
-Pallas interpreter, which is how CPU CI tests it token-exactly against
-the dense path; the op-tier seam (`ops/paged_attention.py`) forces
-interpret whenever no TPU is attached.
+`paged_verify_attention` is the speculative-decoding sibling (PR 7):
+the same per-slot grid, block-table walk, and fused-write machinery,
+widened from one query per slot to a fixed `[W = K+1]` token window.
+Each program fires W write DMAs (live window rows through the table,
+dead rows to the null block) before the walk, waits for ALL of them
+just before the first block the window writes into is streamed (blocks
+below the feed position are write-independent and stream concurrently
+with the writes), and carries the online-softmax state per window row
+— so a verify step's HBM traffic is one context walk amortized over
+K+1 scored positions, which is the whole speculative-decoding win.
+
+Interpret mode (`interpret=True`) runs the same kernels through the
+Pallas interpreter, which is how CPU CI tests them token-exactly
+against the dense path; the op-tier seam (`ops/paged_attention.py`)
+forces interpret whenever no TPU is attached.
 """
 from __future__ import annotations
 
@@ -36,7 +47,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "paged_verify_attention"]
 
 _NEG_INF = -1e30
 
@@ -210,3 +221,192 @@ def paged_decode_attention(q, knew, vnew, kpool, vpool, layer,
     )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
       q3, k3, v3, kpool, vpool)
     return out.reshape(slots, 1, heads, head_dim), new_kpool, new_vpool
+
+
+def _verify_kernel(bt_ref, pos_ref, dlen_ref, q_ref, knew_ref, vnew_ref,
+                   kpool_in, vpool_in, o_ref, kpool_ref, vpool_ref,
+                   kbuf, vbuf, copy_sems, write_sems, *,
+                   layer, block_size, scale, max_blocks):
+    """One program per slot, W = K+1 window rows. bt_ref
+    [slots, max_blocks], pos_ref [slots] (row-0 absolute position) and
+    dlen_ref [slots] (live rows = 0..dlen) are scalar-prefetch (SMEM).
+    q/knew/vnew refs are `[1, W, heads, D]` per-slot blocks;
+    write_sems is `[2, W]` (one k/v DMA pair per window row)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = pl.program_id(0)
+    pos = pos_ref[s]
+    dlen = dlen_ref[s]
+    W = q_ref.shape[1]                  # static window width
+    first_wb = pos // block_size        # first block the window writes
+    last_blk = (pos + dlen) // block_size
+    nblk = last_blk + 1
+
+    # fused KV writes, one DMA pair per window row: live rows land
+    # through the table (the engine pre-promoted every touched block to
+    # private ownership), dead rows (i > dlen) land in the null block 0
+    writes = []
+    for i in range(W):
+        wpos = pos + i
+        live = i <= dlen
+        bid = jnp.where(
+            live,
+            bt_ref[s, jnp.minimum(wpos // block_size, max_blocks - 1)],
+            0)
+        off = wpos % block_size
+        wk = pltpu.make_async_copy(knew_ref.at[0, i],
+                                   kpool_ref.at[layer, bid, off],
+                                   write_sems.at[0, i])
+        wv = pltpu.make_async_copy(vnew_ref.at[0, i],
+                                   vpool_ref.at[layer, bid, off],
+                                   write_sems.at[1, i])
+        wk.start()
+        wv.start()
+        writes.append((wk, wv))
+
+    def wait_writes():
+        for wk, wv in writes:
+            wk.wait()
+            wv.wait()
+
+    def kv_copies(j, buf):
+        bid = bt_ref[s, j]
+        return (pltpu.make_async_copy(kpool_ref.at[layer, bid],
+                                      kbuf.at[buf], copy_sems.at[0, buf]),
+                pltpu.make_async_copy(vpool_ref.at[layer, bid],
+                                      vbuf.at[buf], copy_sems.at[1, buf]))
+
+    def start_copies(j, buf):
+        ck, cv = kv_copies(j, buf)
+        ck.start()
+        cv.start()
+
+    @pl.when(first_wb == 0)
+    def _writes_cover_first():      # window touches block 0: land first
+        wait_writes()
+        start_copies(0, 0)
+
+    @pl.when(first_wb > 0)
+    def _first():                   # block 0 is write-independent
+        start_copies(0, 0)
+
+    # inputs stay at the pool dtype through the matmuls; accumulation
+    # is forced fp32 — the same policy as decode and the dense paths
+    q = q_ref[0].astype(kbuf.dtype)             # [W, heads, D]
+    _, heads, head_dim = q.shape
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            @pl.when(j + 1 == first_wb)
+            def _writes_land_first():   # at most once per program
+                wait_writes()
+
+            start_copies(j + 1, (j + 1) % 2)
+
+        ck, cv = kv_copies(j, j % 2)
+        ck.wait()
+        cv.wait()
+        k = kbuf[j % 2]                         # [bs, heads, D]
+        v = vbuf[j % 2]
+        sc = jnp.einsum("whd,khd->hwk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+        # causal per window row over absolute positions
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (W, block_size), 1)
+        qpos = pos + jax.lax.broadcasted_iota(
+            jnp.int32, (W, block_size), 0)
+        sc = jnp.where((kpos <= qpos)[None], sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)                 # [heads, W, bs] fp32
+        alpha = jnp.exp(m - m_new)              # [heads, W, 1]
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "hwk,khd->hwd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((heads, W, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((heads, W, 1), jnp.float32)
+    acc0 = jnp.zeros((heads, W, head_dim), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)) \
+        .transpose(1, 0, 2).astype(o_ref.dtype)
+
+
+def paged_verify_attention(q, knew, vnew, kpool, vpool, layer,
+                           block_tables, positions, draft_lens,
+                           scale=None, interpret: bool = False):
+    """Fused speculative-verify attention over the global pool, one
+    layer.
+
+    q/knew/vnew: `[slots, W, heads, head_dim]` — the K-token verify
+    window's projections (W = K+1). kpool/vpool:
+    `[layers, num_blocks, block_size, heads, head_dim]`. layer: python
+    int (static). block_tables `[slots, max_blocks]` int32; positions
+    `[slots]` int32 (window row 0's absolute position); draft_lens
+    `[slots]` int32 — rows past a slot's draft length write the null
+    block and produce garbage the engine discards.
+
+    Returns `(out [slots, W, heads, head_dim], new_kpool, new_vpool)`
+    with the pools updated in place when XLA can alias them — the same
+    contract as `paged_decode_attention`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    slots, W, heads, head_dim = q.shape
+    assert W >= 2, "verify window needs at least one draft row (W >= 2)"
+    num_layers, num_blocks, block_size, _, _ = kpool.shape
+    max_blocks = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / (head_dim ** 0.5)
+
+    k4 = knew.astype(kpool.dtype)
+    v4 = vnew.astype(vpool.dtype)
+
+    kernel = functools.partial(_verify_kernel, layer=int(layer),
+                               block_size=block_size, scale=scale,
+                               max_blocks=max_blocks)
+    row = lambda s, *_: (s, 0, 0, 0)  # noqa: E731 — [1, W, heads, D]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,     # block_tables, positions, draft_lens
+        grid=(slots,),
+        in_specs=[
+            pl.BlockSpec((1, W, heads, head_dim), row),
+            pl.BlockSpec((1, W, heads, head_dim), row),
+            pl.BlockSpec((1, W, heads, head_dim), row),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, W, heads, head_dim), row),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, heads, head_dim), kpool.dtype),
+            pltpu.VMEM((2, block_size, heads, head_dim), vpool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),   # [k|v, stream buffer]
+            pltpu.SemaphoreType.DMA((2, W)),   # [k|v, window row] write
+        ],
+    )
+    out, new_kpool, new_vpool = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, W, heads, head_dim), q.dtype),
+            jax.ShapeDtypeStruct(kpool.shape, kpool.dtype),
+            jax.ShapeDtypeStruct(vpool.shape, vpool.dtype),
+        ],
+        # flat input order: bt, pos, dlen, q, knew, vnew, kpool, vpool
+        # — the pools alias outputs 1/2 so writes mutate in place
+        input_output_aliases={6: 1, 7: 2},
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
+      draft_lens.astype(jnp.int32), q, k4, v4, kpool, vpool)
+    return out, new_kpool, new_vpool

@@ -102,7 +102,7 @@ SUITE_ROWS = (
     "gpt_decode_kv_350m", "gpt_engine_offered_load",
     "paged_attention_decode_sweep", "gpt_engine_offered_load_pallas",
     "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill",
-    "gpt_engine_speculative",
+    "gpt_engine_speculative", "gpt_engine_offered_load_mp2",
 )
 
 
@@ -202,6 +202,8 @@ def suite():
     cases["gpt_engine_prefix_cache"] = _engine_prefix_cache_case()
     cases["gpt_engine_chunked_prefill"] = _engine_chunked_prefill_case()
     cases["gpt_engine_speculative"] = _engine_speculative_case()
+    cases["gpt_engine_offered_load_mp2"] = _engine_offered_load_case(
+        mp_degree=2)
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -346,7 +348,8 @@ def _paged_attention_sweep_case(num_slots=8, heads=16, head_dim=128,
 
 def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
                               block_size=16, prefill_buckets=None,
-                              seed=0, attention_backend=None):
+                              seed=0, attention_backend=None,
+                              mp_degree=None):
     """Engine-level offered-load row: the continuous-batching engine
     (paged KV cache + slot scheduler, inference/engine.py) serving a
     mixed trace of prompts/output lengths; the metric is AGGREGATE new
@@ -364,7 +367,12 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
     resolves it); tests call it with a tiny config.
     `attention_backend` selects the paged-attention kernel
     (`gpt_engine_offered_load_pallas` is this same trace with
-    attention_backend='pallas' — the fused-kernel serving number)."""
+    attention_backend='pallas' — the fused-kernel serving number).
+    `mp_degree` serves the SAME trace tensor-parallel over an mp-axis
+    mesh (`gpt_engine_offered_load_mp2`): the row first serves at mp=1
+    for the reference outputs + tokens/s, then at mp_degree, and
+    ASSERTS the outputs token-identical — the headline numbers are the
+    sharded engine's."""
 
     def run_bench():
         import time
@@ -378,6 +386,15 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
             quantile_from_buckets, series_total,
         )
 
+        if mp_degree:
+            import jax
+
+            if len(jax.devices()) < mp_degree:
+                raise RuntimeError(
+                    f"bench row needs {mp_degree} devices for mp="
+                    f"{mp_degree}, have {len(jax.devices())} — run on "
+                    "a TPU slice or a virtual mesh "
+                    "(XLA_FLAGS=--xla_force_host_platform_device_count)")
         cfg = model_cfg or GPTConfig(
             vocab_size=50304, hidden_size=1024, num_layers=24,
             num_heads=16, max_seq_len=512)
@@ -385,43 +402,89 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
         reqs = requests or [
             (int(rng.randint(24, 193)), int(rng.randint(32, 129)))
             for _ in range(24)]                # (prompt_len, max_new)
+        prompts = [rng.randint(0, cfg.vocab_size, plen)
+                   for plen, _ in reqs]
         model = GPTForCausalLM(cfg)
         model.eval()
         buckets = prefill_buckets or tuple(
             b for b in (32, 64, 128, 256, cfg.max_seq_len)
             if b <= cfg.max_seq_len)
-        engine = GenerationEngine(model, num_slots=num_slots,
-                                  block_size=block_size,
-                                  prefill_buckets=buckets,
-                                  attention_backend=attention_backend)
-        if attention_backend and \
-                engine.attention_backend != attention_backend:
-            # the env knob overrides the constructor (deploy semantics)
-            # — but a bench row NAMED for a backend must never record
-            # another backend's numbers under that name
-            raise RuntimeError(
-                f"bench row requested attention_backend="
-                f"{attention_backend!r} but the engine resolved "
-                f"{engine.attention_backend!r} (is "
-                "PADDLE_PAGED_ATTENTION_BACKEND set?) — unset it to "
-                "run this row")
-        # warm every compiled program the trace will hit (bucketed
-        # prefill per bucket + the one decode step), then measure
-        for b in sorted({engine._bucket_for(p) for p, _ in reqs}):
-            warm_len = min(b, engine.max_model_len - 2)
-            engine.add_request(rng.randint(0, cfg.vocab_size, warm_len),
-                               max_new_tokens=2)
-        engine.run()
-        base = engine.tokens_generated
-        engine.metrics.reset()             # drop warmup observations
-        for plen, max_new in reqs:
-            engine.add_request(rng.randint(0, cfg.vocab_size, plen),
-                               max_new_tokens=max_new)
-        t0 = time.perf_counter()
-        out = engine.run()
-        dt = time.perf_counter() - t0
-        new_toks = engine.tokens_generated - base
-        assert len(out) == len(reqs)
+
+        def build(mp):
+            engine = GenerationEngine(model, num_slots=num_slots,
+                                      block_size=block_size,
+                                      prefill_buckets=buckets,
+                                      attention_backend=attention_backend,
+                                      mp_degree=mp)
+            if mp and engine.mp_degree != mp:
+                # a row NAMED for an mp degree must never record an
+                # env-overridden mesh's numbers under that name
+                raise RuntimeError(
+                    f"bench row requested mp_degree={mp} but the "
+                    f"engine resolved {engine.mp_degree} (is "
+                    "PADDLE_SERVE_MP set?) — unset it to run this row")
+            if attention_backend and \
+                    engine.attention_backend != attention_backend:
+                # the env knob overrides the constructor (deploy
+                # semantics) — but a bench row NAMED for a backend must
+                # never record another backend's numbers under that name
+                raise RuntimeError(
+                    f"bench row requested attention_backend="
+                    f"{attention_backend!r} but the engine resolved "
+                    f"{engine.attention_backend!r} (is "
+                    "PADDLE_PAGED_ATTENTION_BACKEND set?) — unset it "
+                    "to run this row")
+            return engine
+
+        def serve(engine, warm_rng_seed=1):
+            """Warm every compiled program the trace will hit (bucketed
+            prefill per bucket + the one decode step), then measure."""
+            wrng = np.random.RandomState(warm_rng_seed)
+            for b in sorted({engine._bucket_for(p) for p, _ in reqs}):
+                warm_len = min(b, engine.max_model_len - 2)
+                engine.add_request(
+                    wrng.randint(0, cfg.vocab_size, warm_len),
+                    max_new_tokens=2)
+            engine.run()
+            base = engine.tokens_generated
+            engine.metrics.reset()         # drop warmup observations
+            ids = [engine.add_request(p, max_new_tokens=max_new)
+                   for p, (_, max_new) in zip(prompts, reqs)]
+            t0 = time.perf_counter()
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            new_toks = engine.tokens_generated - base
+            assert len(out) == len(reqs)
+            return dt, new_toks, [list(map(int, out[i])) for i in ids]
+
+        mp_extra = {}
+        if mp_degree:
+            if mp_degree < 2:
+                raise ValueError(
+                    f"mp_degree={mp_degree}: the sharded row compares "
+                    "against mp=1 — ask for a degree >= 2")
+            # reference serve at mp=1: the parity oracle AND the
+            # single-chip tokens/s this row's speedup is judged against
+            ref_engine = build(None)
+            if ref_engine.mp_degree != 1:
+                # PADDLE_SERVE_MP would silently shard the "mp=1"
+                # baseline too, making the parity assert vacuous and
+                # tokens_per_s_mp1 a lie
+                raise RuntimeError(
+                    "the mp=1 reference engine resolved mp="
+                    f"{ref_engine.mp_degree} (is PADDLE_SERVE_MP "
+                    "set?) — unset it to run this row")
+            dt1, toks1, outs1 = serve(ref_engine)
+            engine = build(mp_degree)
+            dt, new_toks, outs = serve(engine)
+            assert outs == outs1, \
+                f"mp={mp_degree} outputs diverged from mp=1"
+            mp_extra = {"mp_degree": mp_degree,
+                        "devices": engine.mesh.size,
+                        "tokens_per_s_mp1": round(toks1 / dt1)}
+        else:
+            engine = build(None)
+            dt, new_toks, _ = serve(engine)
 
         snap = engine.metrics_snapshot()
 
@@ -447,7 +510,8 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
                     snap["engine_pool_used_high_water_blocks"]
                     ["series"][0]["value"]),
                 "decode_recompiles": int(series_total(
-                    snap, "engine_decode_recompiles_total"))}
+                    snap, "engine_decode_recompiles_total")),
+                **mp_extra}
 
     return run_bench
 

@@ -69,6 +69,7 @@ from .topology import (
     CommunicateTopology,
     HybridCommunicateGroup,
     get_hybrid_communicate_group,
+    serving_mesh,
     set_hybrid_communicate_group,
 )
 
@@ -80,4 +81,5 @@ __all__ = [
     "HybridCommunicateGroup", "get_hybrid_communicate_group",
     "set_hybrid_communicate_group", "ProcessMesh", "shard_tensor",
     "with_sharding_constraint", "get_mesh", "PartitionSpec", "AXIS_ORDER",
+    "serving_mesh",
 ]

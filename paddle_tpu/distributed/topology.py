@@ -81,6 +81,35 @@ _PADDLE2MESH = {"data": "dp", "pipe": "pp", "sharding": "sharding",
                 "sep": "cp"}
 
 
+def serving_mesh(mp, *, num_heads=None, vocab_size=None, devices=None):
+    """One-axis `('mp',)` device mesh for tensor-parallel SERVING — the
+    inference-only convenience the GenerationEngine builds its
+    shard_map-compiled steps over, without requiring a full
+    dp/pp/sharding launch.
+
+    Validates the model shapes the Megatron-style inference sharding
+    needs UP FRONT (attention sharded by heads, lm_head/embedding by
+    vocab rows), so a bad degree fails with a clear ValueError here
+    instead of deep inside a per-shard reshape."""
+    mp = int(mp)
+    if mp < 1:
+        raise ValueError(f"mp degree must be >= 1, got {mp}")
+    if num_heads is not None and num_heads % mp != 0:
+        raise ValueError(
+            f"num_heads={num_heads} is not divisible by mp degree "
+            f"{mp} — head-sharded attention needs num_heads % mp == 0")
+    if vocab_size is not None and vocab_size % mp != 0:
+        raise ValueError(
+            f"vocab_size={vocab_size} is not divisible by mp degree "
+            f"{mp} — the vocab-parallel embedding/lm_head needs "
+            "vocab % mp == 0")
+    devices = list(devices) if devices is not None else jax.devices()
+    if mp > len(devices):
+        raise ValueError(
+            f"serving mesh needs {mp} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:mp]), ("mp",))
+
+
 class HybridCommunicateGroup:
     """Analog of HybridCommunicateGroup (topology.py:139): owns the global
     Mesh and answers rank/degree/group queries per parallel dimension."""
@@ -110,6 +139,13 @@ class HybridCommunicateGroup:
         self.mesh = Mesh(dev_array, AXIS_ORDER)
         self.global_rank = jax.process_index()
         self.nranks = n_needed
+
+    @classmethod
+    def for_serving(cls, mp_degree, devices=None):
+        """Inference-only topology: model parallel over `mp_degree`
+        chips, every other axis collapsed — the one-line setup for
+        tensor-parallel serving (no dp/pp/sharding launch required)."""
+        return cls(mp=int(mp_degree), devices=devices)
 
     # -- degree / rank queries (reference API surface) ----------------------
     def get_parallel_mode(self):

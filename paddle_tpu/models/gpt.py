@@ -33,6 +33,48 @@ def _mp_degree():
         return 1
 
 
+def _mp_all_gather(t, mp_axis):
+    """Concatenate a column-parallel activation's shards along the LAST
+    axis inside a shard_map body (tiled all-gather; mesh axis-index
+    order IS the engine's head/column order, so the concat reassembles
+    the logical layout exactly). Gathering is pure data movement — the
+    result is bit-identical to the unsharded activation, which is what
+    keeps tensor-parallel serving token-exact vs mp=1."""
+    import jax
+
+    from paddle_tpu.ops.dispatch import apply
+
+    def fn(a):
+        return jax.lax.all_gather(a, mp_axis, axis=a.ndim - 1,
+                                  tiled=True)
+
+    return apply("mp_all_gather", fn, t)
+
+
+def _vocab_parallel_embed(weight, token_ids, mp_axis):
+    """Embedding lookup over a vocab-sharded table inside a shard_map
+    body (VocabParallelEmbedding, inference edition): each shard
+    gathers the rows it owns (out-of-range ids masked to zero rows),
+    one psum assembles the full embedding. Every id hits exactly ONE
+    shard, so the psum adds exact zeros — bit-identical to the
+    unsharded gather."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.dispatch import apply
+
+    def fn(w, ids):
+        r = jax.lax.axis_index(mp_axis)
+        vl = w.shape[0]
+        loc = ids.astype(jnp.int32) - r * vl
+        inb = (loc >= 0) & (loc < vl)
+        rows = w[jnp.clip(loc, 0, vl - 1)]
+        rows = jnp.where(inb[..., None], rows, jnp.zeros((), w.dtype))
+        return jax.lax.psum(rows, mp_axis)
+
+    return apply("vocab_parallel_embed", fn, weight, token_ids)
+
+
 @dataclass
 class GPTConfig:
     vocab_size: int = 50304
@@ -113,19 +155,60 @@ class GPTAttention(nn.Layer):
             return out, new_cache
         return out
 
-    def forward_prefill(self, x):
-        """Causal forward that ALSO returns this layer's k/v for the
-        whole (padded) buffer — fills the fixed-size decode cache."""
+    def _qkv_heads(self, x, mp_axis):
+        """Project to per-head q/k/v `[B, S, heads, D]`. Unsharded:
+        the fused `[H, 3H]` matmul (3-major reshape, unchanged).
+        Under tensor parallel (`mp_axis` set) the serving engine binds
+        this layer's qkv weight HEAD-GROUPED as `[H, heads/mp, 3, D]`
+        (bias `[heads/mp, 3, D]`): the same full-length dot products
+        produce just this shard's heads — column parallelism, so every
+        float op is identical to mp=1 and token parity is exact."""
         B, S, H = x.shape
-        qkv = self.qkv_proj(x)
-        qkv = mp.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
-        q, k, v = mp.unbind(qkv, axis=2)
+        if mp_axis is None:
+            qkv = self.qkv_proj(x)
+            qkv = mp.reshape(qkv,
+                             [B, S, 3, self.num_heads, self.head_dim])
+            return mp.unbind(qkv, axis=2)
+        from paddle_tpu.ops import nn_ops
+
+        w, b = self.qkv_proj.weight, self.qkv_proj.bias
+        lh = w.shape[1]                    # heads on this shard
+        qkv = nn_ops.linear(
+            x, mp.reshape(w, [H, lh * 3 * self.head_dim]),
+            None if b is None
+            else mp.reshape(b, [lh * 3 * self.head_dim]))
+        qkv = mp.reshape(qkv, [B, S, lh, 3, self.head_dim])
+        return mp.unbind(qkv, axis=3)
+
+    def _attn_out(self, out, B, S, mp_axis):
+        """Merge heads and apply the output projection. Under tensor
+        parallel the shard's heads are all-gathered to the full
+        `[B, S, H]` activation first, and out_proj (bound
+        column-sharded `[H, H/mp]`) is followed by a second gather —
+        full-length dots + exact concats, never a partial-sum psum, so
+        the result is bit-identical to mp=1 (see DESIGN_DECISIONS
+        "Tensor-parallel sharded serving")."""
+        out = mp.reshape(out, [B, S, -1])
+        if mp_axis is not None:
+            out = _mp_all_gather(out, mp_axis)
+        out = self.out_proj(out)
+        if mp_axis is not None:
+            out = _mp_all_gather(out, mp_axis)
+        return out
+
+    def forward_prefill(self, x, mp_axis=None):
+        """Causal forward that ALSO returns this layer's k/v for the
+        whole (padded) buffer — fills the fixed-size decode cache.
+        Under tensor parallel the returned k/v carry only this shard's
+        heads (they feed the shard's pool plane)."""
+        B, S, H = x.shape
+        q, k, v = self._qkv_heads(x, mp_axis)
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=0.0, training=False)
-        return self.out_proj(mp.reshape(out, [B, S, H])), k, v
+        return self._attn_out(out, B, S, mp_axis), k, v
 
     def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
-                              block_row, start, plen):
+                              block_row, start, plen, mp_axis=None):
         """Chunked prefill for ONE slot against the paged pool: write
         this chunk's k/v through the slot's block table and attend the
         chunk's queries over the whole context so far (shared prefix
@@ -135,12 +218,10 @@ class GPTAttention(nn.Layer):
         from paddle_tpu.ops.paged_attention import paged_prefill_chunk
 
         B, C, H = x.shape  # B == 1
-        qkv = self.qkv_proj(x)
-        qkv = mp.reshape(qkv, [B, C, 3, self.num_heads, self.head_dim])
-        q, k, v = mp.unbind(qkv, axis=2)
+        q, k, v = self._qkv_heads(x, mp_axis)
         out, kpool, vpool = paged_prefill_chunk(
             q, k, v, kpool, vpool, layer_idx, block_row, start, plen)
-        return self.out_proj(mp.reshape(out, [B, C, H])), kpool, vpool
+        return self._attn_out(out, B, C, mp_axis), kpool, vpool
 
     def forward_decode(self, x, kcache, vcache, pos):
         """One-token decode against a FIXED-size cache (the jit-friendly
@@ -176,28 +257,30 @@ class GPTAttention(nn.Layer):
                 vcache)
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
-                             block_tables, positions, backend="auto"):
+                             block_tables, positions, backend="auto",
+                             mp_axis=None):
         """Batched one-token decode against the GLOBAL paged KV pool
         (the continuous-batching engine's layer step). x [slots,1,H];
         kpool/vpool [layers, num_blocks, block_size, heads, D];
         positions [slots] per-slot absolute positions; block_tables
         [slots, max_blocks]; backend is the paged-attention kernel
         selector (`auto`/`dense`/`pallas` — ops/paged_attention.py).
+        With `mp_axis` set (inside the engine's shard_map step) the
+        pools and q/k/v carry heads/mp heads; the attention op is
+        head-count agnostic, so both backends run per-shard unchanged.
         Returns (out, new_kpool, new_vpool)."""
         from paddle_tpu.ops.paged_attention import paged_attention_step
 
         B, S, H = x.shape  # S == 1
-        qkv = self.qkv_proj(x)
-        qkv = mp.reshape(qkv, [B, 1, 3, self.num_heads, self.head_dim])
-        q, k, v = mp.unbind(qkv, axis=2)
+        q, k, v = self._qkv_heads(x, mp_axis)
         out, kpool, vpool = paged_attention_step(
             q, k, v, kpool, vpool, layer_idx, block_tables, positions,
             backend=backend)
-        return self.out_proj(mp.reshape(out, [B, 1, H])), kpool, vpool
+        return self._attn_out(out, B, 1, mp_axis), kpool, vpool
 
     def forward_verify_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, draft_lens,
-                             backend="auto"):
+                             backend="auto", mp_axis=None):
         """Speculative K-token verify over the GLOBAL paged pool: one
         fixed `[slots, W]` window per lane (W = K+1: the feed token
         plus the drafts). x [slots,W,H]; positions [slots] absolute
@@ -210,13 +293,11 @@ class GPTAttention(nn.Layer):
         from paddle_tpu.ops.paged_attention import paged_verify_window
 
         B, W, H = x.shape
-        qkv = self.qkv_proj(x)
-        qkv = mp.reshape(qkv, [B, W, 3, self.num_heads, self.head_dim])
-        q, k, v = mp.unbind(qkv, axis=2)
+        q, k, v = self._qkv_heads(x, mp_axis)
         out, kpool, vpool = paged_verify_window(
             q, k, v, kpool, vpool, layer_idx, block_tables, positions,
             draft_lens, backend=backend)
-        return self.out_proj(mp.reshape(out, [B, W, H])), kpool, vpool
+        return self._attn_out(out, B, W, mp_axis), kpool, vpool
 
 
 class GPTMLP(nn.Layer):
@@ -241,8 +322,20 @@ class GPTMLP(nn.Layer):
                 self.fc1.bias.dist_spec = P("mp")
             self.fc2.weight.dist_spec = P("mp", None)
 
-    def forward(self, x):
-        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+    def forward(self, x, mp_axis=None):
+        """Under tensor parallel (`mp_axis` set, serving engine's
+        shard_map step) fc1 AND fc2 are bound column-sharded
+        (`[H, I/mp]` / `[I, H/mp]`): each shard's outputs are
+        full-length dots over the gathered input, concatenated by a
+        tiled all-gather — exact column parallelism both times, never
+        a partial-sum psum, so mp=N output is bit-identical to mp=1."""
+        h = F.gelu(self.fc1(x), approximate=True)
+        if mp_axis is not None:
+            h = _mp_all_gather(h, mp_axis)
+        out = self.fc2(h)
+        if mp_axis is not None:
+            out = _mp_all_gather(out, mp_axis)
+        return self.dropout(out)
 
 
 class GPTBlock(nn.Layer):
@@ -265,18 +358,20 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x
 
-    def forward_prefill(self, x):
-        a, k, v = self.attn.forward_prefill(self.ln1(x))
+    def forward_prefill(self, x, mp_axis=None):
+        a, k, v = self.attn.forward_prefill(self.ln1(x),
+                                            mp_axis=mp_axis)
         x = x + a
-        return x + self.mlp(self.ln2(x)), k, v
+        return x + self.mlp(self.ln2(x), mp_axis=mp_axis), k, v
 
     def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
-                              block_row, start, plen):
+                              block_row, start, plen, mp_axis=None):
         a, kpool, vpool = self.attn.forward_prefill_chunk(
             self.ln1(x), kpool, vpool, layer_idx, block_row, start,
-            plen)
+            plen, mp_axis=mp_axis)
         x = x + a
-        return x + self.mlp(self.ln2(x)), kpool, vpool
+        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+                vpool)
 
     def forward_decode(self, x, kcache, vcache, pos):
         a, kcache, vcache = self.attn.forward_decode(self.ln1(x),
@@ -286,21 +381,24 @@ class GPTBlock(nn.Layer):
         return x + self.mlp(self.ln2(x)), kcache, vcache
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
-                             block_tables, positions, backend="auto"):
+                             block_tables, positions, backend="auto",
+                             mp_axis=None):
         a, kpool, vpool = self.attn.forward_decode_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
-            positions, backend=backend)
+            positions, backend=backend, mp_axis=mp_axis)
         x = x + a
-        return x + self.mlp(self.ln2(x)), kpool, vpool
+        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+                vpool)
 
     def forward_verify_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, draft_lens,
-                             backend="auto"):
+                             backend="auto", mp_axis=None):
         a, kpool, vpool = self.attn.forward_verify_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
-            positions, draft_lens, backend=backend)
+            positions, draft_lens, backend=backend, mp_axis=mp_axis)
         x = x + a
-        return x + self.mlp(self.ln2(x)), kpool, vpool
+        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+                vpool)
 
 
 class GPTModel(nn.Layer):
@@ -328,22 +426,32 @@ class GPTModel(nn.Layer):
             h = blk(h)
         return self.ln_f(h)
 
-    def forward_prefill(self, input_ids):
+    def _embed(self, token_ids, mp_axis):
+        """Token embedding; under tensor parallel the wte table is
+        bound vocab-sharded `[V/mp, H]` and the lookup goes through the
+        masked-gather + psum (exact) vocab-parallel path."""
+        if mp_axis is None:
+            return self.wte(token_ids)
+        return _vocab_parallel_embed(self.wte.weight, token_ids,
+                                     mp_axis)
+
+    def forward_prefill(self, input_ids, mp_axis=None):
         """Fill the decode caches: causal forward over the (padded)
         buffer, collecting per-layer k/v stacked on a leading layer
-        axis (single Tensors, so a compiled decode loop carries them)."""
+        axis (single Tensors, so a compiled decode loop carries them).
+        Under tensor parallel the stacks carry this shard's heads."""
         B, S = input_ids.shape
-        h = self.wte(input_ids) + self.wpe(
+        h = self._embed(input_ids, mp_axis) + self.wpe(
             paddle.arange(S, dtype="int32"))
         ks, vs = [], []
         for blk in self.blocks:
-            h, k, v = blk.forward_prefill(h)
+            h, k, v = blk.forward_prefill(h, mp_axis=mp_axis)
             ks.append(k)
             vs.append(v)
         return self.ln_f(h), mp.stack(ks, axis=0), mp.stack(vs, axis=0)
 
     def forward_prefill_chunk(self, token_ids, start, kpool, vpool,
-                              block_row, plen):
+                              block_row, plen, mp_axis=None):
         """Chunked paged prefill (the engine's incremental admission
         path): token_ids [1,C] — chunk `[start, start+C)` of one
         slot's prompt, padded past `plen`; kpool/vpool the global
@@ -361,10 +469,12 @@ class GPTModel(nn.Layer):
         # bounds for any (start, chunk) combination
         pos_vec = paddle.clip(pos_t + paddle.arange(C, dtype="int32"),
                               0, self.config.max_seq_len - 1)
-        h = self.wte(token_ids) + self.wpe(pos_vec).unsqueeze(0)
+        h = self._embed(token_ids, mp_axis) \
+            + self.wpe(pos_vec).unsqueeze(0)
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_prefill_chunk(
-                h, kpool, vpool, i, block_row, pos_t, plen)
+                h, kpool, vpool, i, block_row, pos_t, plen,
+                mp_axis=mp_axis)
         return self.ln_f(h), kpool, vpool
 
     def forward_decode(self, token_ids, pos, kstack, vstack):
@@ -388,7 +498,8 @@ class GPTModel(nn.Layer):
                 mp.stack(nvs, axis=0))
 
     def forward_decode_paged(self, token_ids, positions, kpool, vpool,
-                             block_tables, backend="auto"):
+                             block_tables, backend="auto",
+                             mp_axis=None):
         """Batched decode step over the paged pool (continuous-batching
         engine path): token_ids [slots,1], positions [slots] int32
         per-slot absolute positions, kpool/vpool
@@ -401,16 +512,17 @@ class GPTModel(nn.Layer):
         compiled step."""
         pos_t = positions.astype("int32") if hasattr(positions, "astype") \
             else paddle.to_tensor(positions, dtype="int32")
-        h = self.wte(token_ids) + self.wpe(pos_t).unsqueeze(1)
+        h = self._embed(token_ids, mp_axis) \
+            + self.wpe(pos_t).unsqueeze(1)
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_decode_paged(
                 h, kpool, vpool, i, block_tables, pos_t,
-                backend=backend)
+                backend=backend, mp_axis=mp_axis)
         return self.ln_f(h), kpool, vpool
 
     def forward_verify_paged(self, token_ids, positions, draft_lens,
                              kpool, vpool, block_tables,
-                             backend="auto"):
+                             backend="auto", mp_axis=None):
         """Speculative verify step over the paged pool (the engine's
         K-token decode): token_ids [slots, W] — the feed token plus up
         to W-1 drafted tokens per lane, positions [slots] int32 row-0
@@ -436,11 +548,11 @@ class GPTModel(nn.Layer):
             pos_t.unsqueeze(1)
             + paddle.arange(W, dtype="int32").unsqueeze(0),
             0, self.config.max_seq_len - 1)            # [B, W]
-        h = self.wte(token_ids) + self.wpe(wpos)
+        h = self._embed(token_ids, mp_axis) + self.wpe(wpos)
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_verify_paged(
                 h, kpool, vpool, i, block_tables, pos_t, dlen_t,
-                backend=backend)
+                backend=backend, mp_axis=mp_axis)
         return self.ln_f(h), kpool, vpool
 
 
@@ -552,9 +664,19 @@ class GPTForCausalLM(nn.Layer):
             pos = pos + 1
         return tokens
 
-    def _logits_of(self, hidden):
-        return paddle.matmul(hidden, self.gpt.wte.weight,
-                             transpose_y=True)
+    def _logits_of(self, hidden, mp_axis=None):
+        """Tied-embedding logits. Under tensor parallel the wte table
+        is bound vocab-sharded, so each shard computes its `[.., V/mp]`
+        logit columns with full-length dots; ONE tiled all-gather
+        assembles the full logits (replicated on every shard) for the
+        host's greedy argmax / speculative acceptance — exact, where a
+        sharded-argmax psum would save bandwidth but lose the simple
+        "full logits on host" contract (DESIGN_DECISIONS r12)."""
+        logits = paddle.matmul(hidden, self.gpt.wte.weight,
+                               transpose_y=True)
+        if mp_axis is not None:
+            logits = _mp_all_gather(logits, mp_axis)
+        return logits
 
     def _generate_cached(self, input_ids, max_length, eos_token_id):
         import paddle_tpu as paddle

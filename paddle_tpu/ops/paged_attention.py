@@ -62,6 +62,15 @@ makes their stale KV rows unreachable until overwritten. Dispatches
 through the same backend seam (`dense` fori-loop fallback /
 `pallas` fused kernel, interpreter-run off-TPU).
 
+Tensor-parallel serving (PR 8): every op here is HEAD-COUNT AGNOSTIC —
+the head axis is read from the arrays, never from model config — so
+the sharded engine runs the SAME ops per shard inside its shard_map
+steps with per-shard pools `[L, blocks, bs, heads/mp, D]` and q/k/v
+carrying heads/mp heads. Attention is independent per head, so no
+collectives appear at this tier; the block tables and positions arrive
+replicated (one logical allocator on the host), which is why a block
+id means the same row range on every shard.
+
 Implementation notes:
 - functional `.at[].set` / aliased-pool writes chain through the layer
   stack; under the engine's donated compiled step XLA aliases them in

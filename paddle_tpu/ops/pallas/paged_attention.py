@@ -39,6 +39,15 @@ Interpret mode (`interpret=True`) runs the same kernels through the
 Pallas interpreter, which is how CPU CI tests them token-exactly
 against the dense path; the op-tier seam (`ops/paged_attention.py`)
 forces interpret whenever no TPU is attached.
+
+Tensor-parallel serving (PR 8): the kernels read `heads` from the
+operand shapes, never from model config, so the sharded engine invokes
+them PER SHARD inside shard_map with heads/mp-head pools and
+projections — one grid program per slot per shard, each walking the
+same replicated block table over its own pool plane. No cross-shard
+communication exists at this level (attention is per-head); the
+interpreter path composes with shard_map the same way, which is how
+the virtual-mesh CPU CI proves the sharded kernel token-exact.
 """
 from __future__ import annotations
 

@@ -28,6 +28,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: exhaustive sweeps excluded from the timed tier-1 gate "
+        "(ROADMAP runs with -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu

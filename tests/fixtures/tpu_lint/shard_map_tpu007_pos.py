@@ -1,0 +1,12 @@
+import jax  # noqa: F401
+from jax.experimental.shard_map import shard_map
+
+import paddle_tpu.distributed as dist
+
+
+def body(x):
+    dist.all_reduce(x)
+    return x
+
+
+step = shard_map(body, mesh=None, in_specs=None, out_specs=None)

@@ -49,6 +49,25 @@ def test_rule_fixture(rule, pos, neg, lines):
         [f.render() for f in neg_findings]
 
 
+def test_shard_map_bodies_are_traced_contexts():
+    """ISSUE 8 satellite: a callable staged through
+    `jax.experimental.shard_map.shard_map` is a traced context for the
+    jit-reachability walker — host syncs (TPU001) and eager
+    collectives (TPU007) inside the body are findings, while the
+    mesh-level `jax.lax.psum`/`all_gather` the sharded serving engine
+    actually uses never misfire."""
+    findings = analyze("shard_map_pos.py")
+    assert hits(findings, "TPU001") == [(6, False)], \
+        [f.render() for f in findings]
+    assert {f.rule for f in findings} == {"TPU001"}
+    findings = analyze("shard_map_tpu007_pos.py")
+    assert hits(findings, "TPU007") == [(8, False)], \
+        [f.render() for f in findings]
+    assert {f.rule for f in findings} == {"TPU007"}
+    neg = analyze("shard_map_neg.py")
+    assert not neg, [f.render() for f in neg]
+
+
 def test_unparseable_file_is_reported_not_skipped():
     findings = analyze("unparseable.py")
     assert [f.rule for f in findings] == ["TPU000"]
@@ -213,13 +232,14 @@ def test_cli_stats_reports_counts_and_unparseable():
     res = _run_lint([str(FIXTURES), "--baseline", "none", "--stats"])
     assert res.returncode == 1
     out = res.stdout
-    assert "files analyzed: 18" in out
+    assert "files analyzed: 21" in out
     assert "UNPARSEABLE files: 1" in out
     assert "unparseable.py" in out
-    # per-rule counts visible (no silent skips)
-    for rule, n in [("TPU001", 4), ("TPU002", 2), ("TPU003", 2),
+    # per-rule counts visible (no silent skips); the shard_map
+    # fixtures add one TPU001 and one TPU007 hit
+    for rule, n in [("TPU001", 5), ("TPU002", 2), ("TPU003", 2),
                     ("TPU004", 2), ("TPU005", 4), ("TPU006", 2),
-                    ("TPU007", 1), ("TPU008", 1)]:
+                    ("TPU007", 2), ("TPU008", 1)]:
         assert any(line.startswith(rule) and line.rstrip().endswith(str(n))
                    for line in out.splitlines()), (rule, n, out)
     assert "suppressed inline: 1" in out

@@ -1,0 +1,143 @@
+"""tpu-lint — trace-safety & recompile-hazard static analysis.
+
+AST-level (not jaxpr-level: see DESIGN_DECISIONS) analysis of this
+codebase's JAX idioms. `analyze_paths` is the in-process API the
+tier-1 gate uses; `tools/tpu_lint.py` is the CLI. The package
+`__init__` forwards every name here lazily — this module is the
+implementation, loaded only when analysis actually runs, never by a
+plain `import paddle_tpu`.
+
+Importing this package must not initialize a JAX backend — it reads
+only `paddle_tpu.jit.introspect` (pure metadata) from the framework.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from .baseline import (BaselineError, apply_baseline, load_baseline,
+                       write_baseline)
+from .engine import ModuleAnalysis
+from .findings import (Finding, apply_suppressions, assign_ids,
+                       parse_suppressions)
+from .rules import RULES, all_rule_ids
+
+__all__ = ["analyze_file", "analyze_paths", "collect_files", "Finding",
+           "Result", "RULES", "all_rule_ids", "load_baseline",
+           "apply_baseline", "write_baseline", "BaselineError"]
+
+
+@dataclass
+class Result:
+    findings: list = field(default_factory=list)
+    files: list = field(default_factory=list)
+    parse_errors: list = field(default_factory=list)   # (path, message)
+    stale_baseline: list = field(default_factory=list)
+
+    def new_findings(self):
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def per_rule_counts(self):
+        out = {r: 0 for r in all_rule_ids()}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def _module_name(path):
+    """Dotted module name when the file sits under a package root we
+    know (relative imports resolve through it)."""
+    parts = os.path.normpath(path).split(os.sep)
+    if "paddle_tpu" in parts:
+        parts = parts[parts.index("paddle_tpu"):]
+        if parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][:-3]
+        # keep the trailing '__init__': the engine derives the package
+        # for relative imports by dropping the LAST component, which
+        # for a package __init__ must yield the package itself
+        return ".".join(parts)
+    return os.path.basename(path)[:-3] if path.endswith(".py") else path
+
+
+#: Paths in findings (and therefore finding IDs) are made relative to
+#: the REPO ROOT, never the cwd — the committed baseline must match
+#: no matter where the gate is invoked from.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _display_path(path):
+    if not os.path.isabs(path) and not os.path.exists(path):
+        # synthetic name for an in-memory snippet: keep verbatim
+        return os.path.normpath(path).replace(os.sep, "/")
+    p = os.path.abspath(path)
+    try:
+        rel = os.path.relpath(p, _REPO_ROOT)
+    except ValueError:
+        rel = p
+    if not rel.startswith(".."):
+        p = rel
+    return p.replace(os.sep, "/")
+
+
+def analyze_file(path, src=None):
+    """-> list of findings for one file (IDs not yet assigned). A
+    syntax error yields a single TPU000 finding — unparseable files
+    are REPORTED, never silently dropped."""
+    display = _display_path(path)
+    if src is None:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+    try:
+        mod = ModuleAnalysis(display, src, module_name=_module_name(path))
+    except SyntaxError as e:
+        return [Finding(rule="TPU000", path=display,
+                        line=e.lineno or 1, col=(e.offset or 1) - 1,
+                        message=f"unparseable file: {e.msg}")], None
+    findings = []
+    for rule_id in all_rule_ids():
+        check = RULES[rule_id][2]
+        if check is not None:
+            findings.extend(check(mod))
+    apply_suppressions(findings, parse_suppressions(src))
+    return findings, mod
+
+
+def collect_files(paths):
+    """Expand files/dirs into a sorted .py file list."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        out.append(os.path.join(root, n))
+        else:
+            out.append(p)
+    return out
+
+
+def analyze_paths(paths, baseline=None):
+    """Analyze files/directories. `baseline` is {id: entry} (see
+    load_baseline). Returns Result with stable IDs assigned and
+    suppressions/baseline applied."""
+    res = Result()
+    for path in collect_files(paths):
+        findings, _mod = analyze_file(path)
+        res.files.append(_display_path(path))
+        for f in findings:
+            if f.rule == "TPU000":
+                res.parse_errors.append((f.path, f.message))
+        res.findings.extend(findings)
+    assign_ids(res.findings)
+    if baseline:
+        res.stale_baseline = apply_baseline(res.findings, baseline)
+    else:
+        res.stale_baseline = []
+    res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return res

@@ -1,0 +1,53 @@
+"""tpu-verify — jaxpr/StableHLO trace-contract checking.
+
+The second analysis tier: where tpu-lint (`paddle_tpu.analysis`, AST)
+catches hazards in the python that tracing ERASES, this package
+checks the properties only visible in what tracing PRODUCES — the
+jaxpr and lowered StableHLO of every registered compiled engine
+program (DESIGN_DECISIONS r9 drew exactly this boundary; r13 closes
+it). `verify_matrix` is the in-process API the tier-1 gate uses;
+`tools/tpu_verify.py` is the CLI.
+
+LAZY package init (PEP 562), for the same reason as the parent
+package: the engine/model/op modules import
+`analysis.trace.contracts` (pure data) at module scope to declare
+their contracts, so `import paddle_tpu` executes this file — the
+checker itself (rules, harvester) loads only when verification runs.
+A JAX backend is initialized only once `harvest()` is invoked, and
+even then programs are traced/lowered abstractly, never executed.
+"""
+from __future__ import annotations
+
+_EXPORTS = {
+    "contracts": ("CollectiveBudget", "TraceContract", "get_contract",
+                  "register_contract", "registered_contracts",
+                  "resolve_budget"),
+    "harvest": ("DEFAULT_TRACE_BASELINE", "TraceResult",
+                "apply_findings_baseline", "compare_snapshot",
+                "default_matrix", "harvest", "load_trace_baseline",
+                "snapshot_of", "verify_matrix",
+                "write_trace_baseline"),
+    "rules": ("TRACE_RULES", "TracedProgram", "all_trace_rule_ids",
+              "check_program", "collective_counts", "const_entries",
+              "iter_eqns", "op_counts", "total_const_bytes"),
+}
+
+__all__ = sorted(n for names in _EXPORTS.values() for n in names)
+
+_WHENCE = {name: mod for mod, names in _EXPORTS.items()
+           for name in names}
+
+
+def __getattr__(name):
+    mod = _WHENCE.get(name)
+    if mod is not None:
+        import importlib
+
+        return getattr(
+            importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_WHENCE))

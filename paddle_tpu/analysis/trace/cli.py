@@ -1,0 +1,170 @@
+"""tpu-verify CLI implementation (thin wrapper lives in
+tools/tpu_verify.py), mirroring tpu_lint's interface.
+
+Exit codes: 0 clean (against baselines), 1 findings, 2 usage/baseline
+error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..baseline import BaselineError, load_baseline, write_baseline
+from .harvest import DEFAULT_TRACE_BASELINE, _REPO_ROOT, \
+    load_trace_baseline, verify_matrix, write_trace_baseline
+from .rules import TRACE_RULES, all_trace_rule_ids
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "tpu_verify_baseline.json")
+
+
+def _print_stats(res, out):
+    counts = res.per_rule_counts()
+    suppressed = sum(1 for f in res.findings if f.suppressed)
+    baselined = sum(1 for f in res.findings if f.baselined)
+    print("-- tpu-verify stats ----------------------------------",
+          file=out)
+    print(f"programs traced: {len(res.programs)}", file=out)
+    for p in res.programs:
+        print(f"  {p.key}: {sum(p.ops.values())} eqns, "
+              f"collectives={p.collectives or '{}'}, "
+              f"const_bytes={p.const_bytes}", file=out)
+    for rule in all_trace_rule_ids():
+        name = TRACE_RULES[rule][0]
+        print(f"{rule} {name:<26} {counts.get(rule, 0)}", file=out)
+    print(f"suppressed (contract waivers): {suppressed}   "
+          f"baselined: {baselined}", file=out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpu_verify",
+        description="jaxpr/StableHLO trace-contract checker for every "
+                    "compiled engine step")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="findings baseline JSON ('none' disables; "
+                         "default: tools/tpu_verify_baseline.json "
+                         "when present)")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current new findings as a baseline "
+                         "skeleton (justifications left empty on "
+                         "purpose) and exit")
+    ap.add_argument("--trace-baseline", default=None,
+                    help="drift snapshot JSON ('none' disables; "
+                         "default: TRACE_BASELINE.json at the repo "
+                         "root when present)")
+    ap.add_argument("--write-trace-baseline", nargs="?", metavar="PATH",
+                    const=DEFAULT_TRACE_BASELINE,
+                    help="re-snapshot per-program op/collective/byte "
+                         "counts (default path: the committed "
+                         "TRACE_BASELINE.json) and exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-program trace stats and per-rule "
+                         "finding counts")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_trace_rule_ids():
+            name, desc, _ = TRACE_RULES[rule]
+            print(f"{rule}  {name:<26} {desc}")
+        return 0
+
+    baseline = {}
+    if args.baseline != "none" and not args.write_baseline:
+        bpath = args.baseline or (
+            DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE)
+            else None)
+        if args.baseline and not os.path.exists(args.baseline):
+            print(f"tpu_verify: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+        if bpath:
+            try:
+                baseline = load_baseline(bpath)
+            except (BaselineError, json.JSONDecodeError) as e:
+                print(f"tpu_verify: bad baseline {bpath}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    # resolve AND load the drift snapshot BEFORE the (expensive)
+    # harvest: a corrupt file is a usage error (exit 2), not a
+    # 15s-later traceback
+    trace_baseline = None
+    if not args.write_trace_baseline and args.trace_baseline != "none":
+        tb_path = args.trace_baseline or (
+            DEFAULT_TRACE_BASELINE
+            if os.path.exists(DEFAULT_TRACE_BASELINE) else None)
+        if args.trace_baseline and not os.path.exists(
+                args.trace_baseline):
+            print("tpu_verify: trace baseline not found: "
+                  f"{args.trace_baseline}", file=sys.stderr)
+            return 2
+        if tb_path:
+            try:
+                trace_baseline = load_trace_baseline(tb_path)
+            except (json.JSONDecodeError, OSError) as e:
+                print(f"tpu_verify: bad trace baseline {tb_path}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    try:
+        res = verify_matrix(baseline=baseline,
+                            trace_baseline=trace_baseline)
+    except RuntimeError as e:
+        print(f"tpu_verify: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_trace_baseline:
+        n = write_trace_baseline(args.write_trace_baseline,
+                                 res.programs)
+        print(f"snapshotted {n} programs to "
+              f"{args.write_trace_baseline} — review the diff before "
+              "committing")
+        return 0
+
+    if args.write_baseline:
+        # drift (TPU100) is excluded: its ID ignores the drift
+        # content, so a baseline entry would mask all future drift of
+        # that program — drift acceptance is --write-trace-baseline
+        n = write_baseline(args.write_baseline,
+                           [f for f in res.new_findings()
+                            if f.rule != "TPU100"])
+        print(f"wrote {n} entries to {args.write_baseline} — add a "
+              "justification to each (the loader rejects empty ones; "
+              "TPU100 drift is never grandfatherable)")
+        return 0
+
+    new = res.new_findings()
+    if args.format == "json":
+        doc = {
+            "findings": [f.to_dict() for f in new],
+            "suppressed": sum(1 for f in res.findings if f.suppressed),
+            "baselined": sum(1 for f in res.findings if f.baselined),
+            "stale_baseline": res.stale_baseline,
+            "stale_trace_baseline": res.stale_trace_baseline,
+            "programs": [p.key for p in res.programs],
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for bid in res.stale_baseline:
+            print(f"note: stale baseline entry {bid} — no current "
+                  "finding matches; remove it")
+        for key in res.stale_trace_baseline:
+            print(f"note: stale TRACE_BASELINE entry {key} — no "
+                  "current program matches; re-snapshot")
+        if not new:
+            print(f"tpu-verify clean: {len(res.programs)} programs, "
+                  f"{sum(1 for f in res.findings if f.baselined)} "
+                  "baselined, "
+                  f"{sum(1 for f in res.findings if f.suppressed)} "
+                  "waived")
+    if args.stats:
+        _print_stats(res, sys.stdout)
+    return 1 if new else 0

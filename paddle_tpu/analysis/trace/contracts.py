@@ -1,0 +1,150 @@
+"""TraceContract — a compiled program's declared trace-time contract.
+
+This module is deliberately PURE DATA (no jax import, no framework
+import): the modules that BUILD compiled programs (inference/engine.py,
+models/gpt.py, ops/paged_attention.py) import it at module scope to
+declare their contracts right next to the step builders, and importing
+them must never pull analysis machinery — let alone a JAX backend —
+into the process. The harvester (`analysis.trace.harvest`) imports the
+builder modules lazily, which is what fills the registry.
+
+A contract declares what must hold in the program AFTER tracing —
+the properties tpu-lint's AST pass cannot see (DESIGN_DECISIONS r9's
+false-negative boundary): donation really aliasing, no weights baked
+as constants, fp32 accumulation on narrow-dtype contractions, a
+bounded collective count per sharded step, strong-typed trace keys,
+and no host callbacks. `analysis.trace.rules` enforces them per
+harvested (program, config) pair.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class CollectiveBudget:
+    """Upper bound on mesh collectives per compiled step, split into a
+    per-transformer-layer part and a fixed (embed / lm-head) part:
+    allowed(kind) = per_layer[kind] * num_layers + fixed[kind]. A kind
+    absent from both maps is allowed zero times — an accidental extra
+    all-gather (or a brand-new reduce-scatter) in a sharded step fails
+    TPU104 instead of silently stretching every decode iteration."""
+
+    per_layer: tuple = ()        # (("all_gather", 4), ...)
+    fixed: tuple = ()            # (("all_gather", 1), ("psum", 1), ...)
+
+    def allowed(self, kind, num_layers):
+        per = dict(self.per_layer).get(kind, 0)
+        fix = dict(self.fixed).get(kind, 0)
+        return per * num_layers + fix
+
+    def kinds(self):
+        return sorted(set(dict(self.per_layer)) | set(dict(self.fixed)))
+
+
+@dataclass(frozen=True)
+class TraceContract:
+    """Declared trace-time contract for ONE compiled program.
+
+    name: the program's `__name__` (the engine's step-body names);
+        doubles as the key into `introspect.ENGINE_STEP_DONATION`.
+    declared_at: repo-relative path of the module declaring this
+        contract — findings anchor there, so a TPU1xx failure points
+        at the step builder, not the checker.
+    donate_argnums: positional args whose buffers the program donates;
+        TPU101 requires one pinned input/output alias per donated
+        array leaf in the lowered module.
+    collective_budget: CollectiveBudget for the program's SHARDED
+        (mp > 1) lowering, or a lazy "pkg.mod:NAME" reference resolved
+        at harvest time (keeps this declaration colocated with the
+        engine while the budget itself lives next to the collective-
+        emitting model code). At mp == 1 every program's budget is
+        zero collectives regardless of this field.
+    max_const_bytes: TPU102 threshold — any single constant baked into
+        the jaxpr above this size fails (weights/tables must ride as
+        traced arguments, never closure captures).
+    accum_dtype: minimum accumulation width TPU103 demands of
+        contractions (dot_general) and add-reductions over
+        sub-fp32 operands.
+    allow_host_callbacks: TPU106 — compiled hot-path steps must never
+        re-enter python mid-program.
+    waive: ((rule_id, justification), ...) — inline, colocated
+        suppressions. Empty justifications are rejected at check time,
+        same etiquette as the committed baseline.
+    """
+
+    name: str
+    declared_at: str
+    donate_argnums: tuple = ()
+    collective_budget: object = None      # CollectiveBudget | "mod:NAME"
+    max_const_bytes: int = 4096
+    accum_dtype: str = "float32"
+    allow_host_callbacks: bool = False
+    waive: tuple = ()
+
+    def waived(self, rule_id):
+        """Justification string when rule_id is waived, else None.
+        An empty justification is a declaration error, not a waiver."""
+        for rid, why in self.waive:
+            if rid == rule_id:
+                if not str(why).strip():
+                    raise ValueError(
+                        f"contract {self.name} waives {rid} without a "
+                        "justification — write the reason or fix it")
+                return why
+        return None
+
+
+#: name -> TraceContract, filled by the builder modules' import-time
+#: declarations (engine steps, the COW block copy).
+_REGISTRY = {}
+
+
+def register_contract(contract):
+    """Publish a contract (idempotent re-registration with identical
+    content is fine — modules may be reimported; a CONFLICTING
+    redeclaration is a bug and raises)."""
+    prev = _REGISTRY.get(contract.name)
+    if prev is not None and prev != contract:
+        raise ValueError(
+            f"conflicting TraceContract redeclaration for "
+            f"{contract.name!r}")
+    _REGISTRY[contract.name] = contract
+    return contract
+
+
+def get_contract(name):
+    c = _REGISTRY.get(name)
+    if c is None:
+        raise KeyError(
+            f"no TraceContract registered under {name!r} — declare it "
+            "next to the step builder (see inference/engine.py)")
+    return c
+
+
+def registered_contracts():
+    return dict(_REGISTRY)
+
+
+def resolve_budget(contract):
+    """Resolve a contract's collective budget, following a lazy
+    "pkg.mod:NAME" reference (the colocation seam: the engine declares
+    WHICH budget applies, the model module owns WHAT it is)."""
+    budget = contract.collective_budget
+    if isinstance(budget, str):
+        import importlib
+
+        mod_name, _, attr = budget.partition(":")
+        try:
+            budget = getattr(importlib.import_module(mod_name), attr)
+        except (ImportError, AttributeError) as e:
+            raise ValueError(
+                f"contract {contract.name} (declared at "
+                f"{contract.declared_at}) references collective "
+                f"budget {contract.collective_budget!r} which does "
+                f"not resolve: {e}") from e
+    if budget is not None and not isinstance(budget, CollectiveBudget):
+        raise TypeError(
+            f"contract {contract.name}: collective_budget must be a "
+            f"CollectiveBudget or 'mod:NAME' reference, got {budget!r}")
+    return budget

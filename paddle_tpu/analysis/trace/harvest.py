@@ -1,0 +1,324 @@
+"""Harvest: abstractly trace every registered compiled engine program
+over the serving config matrix, on CPU, with no device execution.
+
+For each matrix point ({dense,pallas} x K in {0,4} x mp in {1,2}) a
+TINY GPT engine is constructed exactly the way serving constructs it
+(same builders, same jit wrappers, same donation/out_shardings — the
+checker lowers the ENGINE'S OWN jitted objects, so a contract break in
+`inference/engine.py` cannot hide behind a checker-side rebuild), its
+step bodies are traced with `jax.make_jaxpr` and lowered with
+`.lower()`, and the TPU1xx rules run over the resulting
+jaxpr/StableHLO. Tracing and lowering never dispatch a computation;
+the only device interaction is allocating the tiny engine's zeroed
+pools, which is why the whole matrix runs in CPU-only CI.
+
+The committed `TRACE_BASELINE.json` (repo root, next to the other
+baselines) snapshots per-program op/collective/byte counts; any drift
+is a TPU100 finding — an intentional change regenerates it with
+`tools/tpu_verify.py --write-trace-baseline` and reviews the diff.
+
+jax / the framework are imported INSIDE the functions here: importing
+`paddle_tpu.analysis.trace` must not initialize a JAX backend (the
+import-smoke contract).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..findings import Finding, assign_ids
+from .contracts import get_contract
+from .rules import TracedProgram, check_program
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+#: Committed drift snapshot (repo root, BENCH_BASELINE.json precedent).
+DEFAULT_TRACE_BASELINE = os.path.join(_REPO_ROOT, "TRACE_BASELINE.json")
+
+#: The serving config matrix every contract is checked under.
+BACKENDS = ("dense", "pallas")
+SPEC_KS = (0, 4)
+MP_DEGREES = (1, 2)
+
+#: Tiny-but-structurally-real harvest geometry: 2 layers so per-layer
+#: collective budgets multiply, 4 heads so mp=2 head-sharding divides,
+#: block_size 8 so the pallas kernel's sublane constraint holds.
+TINY = dict(vocab=64, hidden=32, layers=2, heads=4, seq=32,
+            slots=2, block_size=8)
+
+
+def default_matrix():
+    return tuple((b, k, mp) for b in BACKENDS for k in SPEC_KS
+                 for mp in MP_DEGREES)
+
+
+def _require_devices(mp):
+    import jax
+
+    if mp > 1 and len(jax.devices()) < mp:
+        raise RuntimeError(
+            f"harvesting the mp={mp} configs needs {mp}+ devices — "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "BEFORE the first jax use (tools/tpu_verify.py does this "
+            "for you)")
+
+
+def _build_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig.tiny(vocab=TINY["vocab"], hidden=TINY["hidden"],
+                         layers=TINY["layers"], heads=TINY["heads"],
+                         seq=TINY["seq"])
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _trace_one(name, config, pure_fn, jitted, args, mp, num_layers):
+    """make_jaxpr + lower ONE program and capture the TracedProgram
+    record the rules consume. `jitted` is the engine's own jit wrapper
+    (its donation and out_shardings, not the checker's)."""
+    import jax
+
+    contract = get_contract(name)
+    closed = jax.make_jaxpr(pure_fn)(*args)
+    lowered = jitted.lower(*args)
+    donated = sum(
+        len(jax.tree_util.tree_leaves(args[i]))
+        for i in contract.donate_argnums)
+    leaves = [(jax.tree_util.keystr(path), leaf) for path, leaf in
+              jax.tree_util.tree_flatten_with_path(args)[0]]
+    return TracedProgram(
+        contract=contract, config=config, mp=mp,
+        num_layers=num_layers, jaxpr=closed,
+        lowered_text=lowered.as_text(), donated_leaves=donated,
+        arg_leaves=leaves)
+
+
+def harvest(matrix=None):
+    """-> list[TracedProgram] over the full contract matrix: one
+    chunked engine per (backend, K, mp) contributes its
+    decode-or-verify step (8 programs — where the backends/K
+    diverge); the backend/K-invariant programs (chunked prefill,
+    legacy bucketed prefill from a bucketed engine, COW block-copy)
+    harvest once per mp (6 more)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.inference.engine import GenerationEngine
+
+    matrix = default_matrix() if matrix is None else tuple(matrix)
+    for _, _, mp in matrix:
+        _require_devices(mp)
+    model = _build_model()
+    L = model.config.num_layers
+    programs = []
+    for backend, K, mp in matrix:
+        config = f"{backend},K={K},mp={mp}"
+        eng = GenerationEngine(
+            model, num_slots=TINY["slots"],
+            block_size=TINY["block_size"], attention_backend=backend,
+            spec_decode_k=K, mp_degree=mp, donate=True)
+        S, MB, C = eng.num_slots, eng.max_blocks, eng.prefill_chunk
+        state = eng._state_arrays()
+        kp, vp = eng.cache.kpool, eng.cache.vpool
+        tokens = jnp.asarray(np.zeros((S, K + 1), np.int32))
+        positions = jnp.asarray(np.zeros(S, np.int32))
+        tables = jnp.asarray(np.zeros((S, MB), np.int32))
+        if K > 0:
+            dlens = jnp.asarray(np.zeros(S, np.int32))
+            step_args = (state, kp, vp, tokens, positions, dlens,
+                         tables)
+            step_name = "engine_verify_step"
+        else:
+            step_args = (state, kp, vp, tokens, positions, tables)
+            step_name = "engine_decode_step"
+        programs.append(_trace_one(
+            step_name, config, eng._decode_pure, eng._decode,
+            step_args, mp, L))
+        # the prefill programs and the COW copy are backend- and
+        # K-invariant today (paged_prefill_chunk has no backend seam;
+        # the decode/verify steps are where the backends diverge), so
+        # they harvest ONCE per mp — if a prefill backend ever grows,
+        # widen this to the full config string
+        if K == 0 and backend == "dense":
+            chunk_tokens = jnp.asarray(np.zeros((1, C), np.int32))
+            row = jnp.asarray(np.zeros(MB, np.int32))
+            programs.append(_trace_one(
+                "engine_prefill_chunk", f"mp={mp}", eng._prefill_pure,
+                eng._prefill,
+                (state, kp, vp, chunk_tokens, jnp.int32(0),
+                 jnp.int32(TINY["block_size"] + 1), row),
+                mp, L))
+            bucket = TINY["seq"] // 2
+            beng = GenerationEngine(
+                model, num_slots=TINY["slots"],
+                block_size=TINY["block_size"],
+                attention_backend=backend,
+                prefill_buckets=(bucket, TINY["seq"]), mp_degree=mp,
+                donate=True)
+            btok = jnp.asarray(np.zeros((1, bucket), np.int32))
+            # every arg from the BUCKETED engine itself — if its
+            # geometry/state layout ever diverges from the chunked
+            # engine's, the harvested signature must follow the real
+            # program, not a lookalike
+            brow = jnp.asarray(np.zeros(beng.max_blocks, np.int32))
+            programs.append(_trace_one(
+                "engine_prefill", f"mp={mp}", beng._prefill_pure,
+                beng._prefill,
+                (beng._state_arrays(), beng.cache.kpool,
+                 beng.cache.vpool, btok, jnp.int32(bucket - 2), brow),
+                mp, L))
+            programs.append(_trace_one(
+                "engine_cow_copy", f"mp={mp}", eng._cow_pure,
+                eng._cow, (kp, vp, jnp.int32(1), jnp.int32(2)),
+                mp, L))
+    return programs
+
+
+# ---------------------------------------------------------------------------
+# drift snapshot (TRACE_BASELINE.json / TPU100)
+# ---------------------------------------------------------------------------
+
+def snapshot_of(programs):
+    """program key -> per-step op/collective/byte counts, the unit of
+    the committed drift baseline."""
+    out = {}
+    for p in programs:
+        out[p.key] = {
+            "ops": {k: p.ops[k] for k in sorted(p.ops)},
+            "collectives": dict(sorted(p.collectives.items())),
+            "const_bytes": p.const_bytes,
+            "donated_aliases":
+                p.lowered_text.count("tf.aliasing_output"),
+        }
+    return out
+
+
+def load_trace_baseline(path):
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("programs", data)
+
+
+def write_trace_baseline(path, programs):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "programs": snapshot_of(programs)},
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(programs)
+
+
+def _diff_counts(cur, base):
+    """Short human summary of what drifted."""
+    bits = []
+    for field in ("const_bytes", "donated_aliases"):
+        if cur[field] != base.get(field):
+            bits.append(f"{field} {base.get(field)} -> {cur[field]}")
+    for field in ("collectives", "ops"):
+        c, b = cur[field], base.get(field, {})
+        for k in sorted(set(c) | set(b)):
+            if c.get(k, 0) != b.get(k, 0):
+                bits.append(f"{k} {b.get(k, 0)} -> {c.get(k, 0)}")
+    return "; ".join(bits[:6]) + (" ..." if len(bits) > 6 else "")
+
+
+def compare_snapshot(programs, baseline):
+    """-> (drift findings [TPU100], stale baseline keys). Exact-match
+    comparison: ANY change in a program's op/collective/byte counts
+    fails loudly until --write-trace-baseline re-snapshots it and the
+    diff is reviewed."""
+    current = snapshot_of(programs)
+    by_key = {p.key: p for p in programs}
+    findings = []
+    for key in sorted(current):
+        prog = by_key[key]
+        if key not in baseline:
+            findings.append(Finding(
+                rule="TPU100", path=prog.contract.declared_at, line=1,
+                col=0, qualname=prog.contract.name, source=prog.config,
+                message=f"program {key} has no TRACE_BASELINE.json "
+                        "entry — run tools/tpu_verify.py "
+                        "--write-trace-baseline and review the "
+                        "snapshot"))
+        elif current[key] != baseline[key]:
+            findings.append(Finding(
+                rule="TPU100", path=prog.contract.declared_at, line=1,
+                col=0, qualname=prog.contract.name, source=prog.config,
+                message=f"program {key} drifted from "
+                        "TRACE_BASELINE.json: "
+                        f"{_diff_counts(current[key], baseline[key])}"
+                        " — intentional? re-snapshot with "
+                        "--write-trace-baseline"))
+    stale = sorted(set(baseline) - set(current))
+    return findings, stale
+
+
+# ---------------------------------------------------------------------------
+# the full check
+# ---------------------------------------------------------------------------
+
+class TraceResult:
+    """Mirror of analysis.Result for the trace tier."""
+
+    def __init__(self):
+        self.findings = []
+        self.programs = []
+        self.stale_baseline = []        # findings-baseline ids
+        self.stale_trace_baseline = []  # snapshot keys
+
+    def new_findings(self):
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def per_rule_counts(self):
+        from .rules import all_trace_rule_ids
+
+        out = {r: 0 for r in all_trace_rule_ids()}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def apply_findings_baseline(res, baseline):
+    """Apply a findings baseline to a TraceResult — EXCEPT TPU100:
+    a drift finding's stable ID hashes the program key, not the drift
+    content, so one grandfathered entry would silently mask every
+    FUTURE drift of that program too. Drift has its own reviewed
+    acceptance mechanism (--write-trace-baseline); a baseline entry
+    matching a TPU100 id is surfaced as stale instead of honored."""
+    from ..baseline import apply_baseline
+
+    return apply_baseline(
+        [f for f in res.findings if f.rule != "TPU100"], baseline)
+
+
+def verify_matrix(matrix=None, baseline=None, trace_baseline="auto"):
+    """Harvest the matrix and run every rule + the drift comparison.
+
+    `baseline` is a loaded findings baseline ({id: entry}, see
+    analysis.baseline) or None; `trace_baseline` is a path, a loaded
+    snapshot dict, "auto" (the committed TRACE_BASELINE.json when
+    present) or None to skip drift checking."""
+    res = TraceResult()
+    res.programs = harvest(matrix)
+    for prog in res.programs:
+        res.findings.extend(check_program(prog))
+    if trace_baseline == "auto":
+        trace_baseline = DEFAULT_TRACE_BASELINE \
+            if os.path.exists(DEFAULT_TRACE_BASELINE) else None
+    if isinstance(trace_baseline, str):
+        trace_baseline = load_trace_baseline(trace_baseline)
+    if trace_baseline is not None:
+        drift, res.stale_trace_baseline = compare_snapshot(
+            res.programs, trace_baseline)
+        res.findings.extend(drift)
+    assign_ids(res.findings)
+    if baseline:
+        res.stale_baseline = apply_findings_baseline(res, baseline)
+    res.findings.sort(key=lambda f: (f.path, f.qualname, f.source,
+                                     f.rule))
+    return res

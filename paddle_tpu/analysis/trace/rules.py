@@ -1,0 +1,396 @@
+"""TPU1xx rules — contract checks over TRACED programs.
+
+tpu-lint's TPU0xx family reads python source; this family reads what
+tracing PRODUCES: the jaxpr and the lowered StableHLO module of every
+registered compiled program, harvested abstractly on CPU (no device
+execution). Each rule takes a `TracedProgram` record and returns
+`analysis.findings.Finding`s anchored at the contract's declaration
+site — the step builder, not the checker.
+
+No rule imports jax: jaxprs are walked by duck typing (`.eqns`,
+`.primitive.name`, `.params`) and dtypes compared by name, so the
+module imports clean in pre-device CI stages (the import-smoke
+contract shared with `paddle_tpu.analysis`).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from functools import cached_property
+
+from ..findings import Finding
+from .contracts import resolve_budget
+
+#: Mesh-collective primitive names TPU104 classifies and counts.
+COLLECTIVE_PRIMS = frozenset({
+    "all_gather", "psum", "psum2", "all_to_all", "ppermute",
+    "pbroadcast", "reduce_scatter", "psum_scatter", "pmin", "pmax",
+    "pgather",
+})
+
+#: Host-callback primitives TPU106 bans from compiled steps.
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call",
+})
+
+#: Contraction / add-reduction primitives whose accumulator dtype
+#: follows the operand dtype unless pinned (TPU103).
+_ACCUM_PRIMS = ("dot_general", "reduce_sum")
+
+#: Floating dtypes narrower than fp32 — accumulating IN them is the
+#: bf16 cancellation bug class (DESIGN_DECISIONS, paged-attention PV
+#: fix).
+_NARROW_FLOATS = ("bfloat16", "float16", "float8_e4m3fn",
+                  "float8_e5m2")
+
+_WIDE_FLOATS = ("float32", "float64")
+
+
+@dataclass
+class TracedProgram:
+    """One harvested (program, config) pair — everything the rules
+    need, captured once so each rule stays a pure function."""
+
+    contract: object                # TraceContract
+    config: str                     # e.g. "dense,K=4,mp=2"
+    mp: int
+    num_layers: int
+    jaxpr: object                   # ClosedJaxpr
+    lowered_text: str               # StableHLO module text
+    donated_leaves: int             # array leaves under donate_argnums
+    arg_leaves: list = field(default_factory=list)  # (path, leaf)
+
+    @property
+    def key(self):
+        return f"{self.contract.name}[{self.config}]"
+
+    # each full jaxpr walk is O(program); rules, the drift snapshot
+    # and --stats all consume the same aggregates, so walk ONCE and
+    # cache on the record
+    @cached_property
+    def ops(self):
+        return op_counts(self.jaxpr)
+
+    @property
+    def collectives(self):
+        return {k: v for k, v in self.ops.items()
+                if k in COLLECTIVE_PRIMS}
+
+    @cached_property
+    def consts(self):
+        return const_entries(self.jaxpr)
+
+    @property
+    def const_bytes(self):
+        return sum(n for _, _, n in self.consts)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking (duck-typed: no jax import)
+# ---------------------------------------------------------------------------
+
+def _inner_jaxpr(obj):
+    """Jaxpr carried by `obj` (a Jaxpr, a ClosedJaxpr, or neither)."""
+    if hasattr(obj, "eqns"):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    return inner if hasattr(inner, "eqns") else None
+
+
+def iter_eqns(jaxpr):
+    """Every equation in `jaxpr` and (recursively) in any sub-jaxpr
+    its equations carry as params — scan/while/cond bodies, pallas
+    kernels, shard_map bodies. Loop bodies are counted ONCE (static
+    program text, not trip-count-weighted)."""
+    top = _inner_jaxpr(jaxpr)
+    if top is None:
+        return
+    for eqn in top.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for sub in vs:
+                inner = _inner_jaxpr(sub)
+                if inner is not None:
+                    yield from iter_eqns(inner)
+
+
+def op_counts(jaxpr):
+    """primitive name -> static occurrence count, recursive."""
+    return Counter(e.primitive.name for e in iter_eqns(jaxpr))
+
+
+def collective_counts(jaxpr):
+    return {k: v for k, v in op_counts(jaxpr).items()
+            if k in COLLECTIVE_PRIMS}
+
+
+def const_entries(jaxpr):
+    """(shape, dtype, nbytes) for every constant closed over by the
+    program, including sub-jaxpr consts."""
+    out = []
+    seen = set()
+
+    def visit(closed):
+        if id(closed) in seen:
+            return
+        seen.add(id(closed))
+        for c in getattr(closed, "consts", ()) or ():
+            if hasattr(c, "nbytes"):
+                out.append((tuple(getattr(c, "shape", ())),
+                            str(getattr(c, "dtype", "?")),
+                            int(c.nbytes)))
+        inner = _inner_jaxpr(closed)
+        if inner is None:
+            return
+        for eqn in inner.eqns:
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (list, tuple)) else (v,)
+                for sub in vs:
+                    if hasattr(sub, "consts") or \
+                            _inner_jaxpr(sub) is not None:
+                        visit(sub)
+
+    visit(jaxpr)
+    return out
+
+
+def total_const_bytes(jaxpr):
+    return sum(n for _, _, n in const_entries(jaxpr))
+
+
+def _dtype_name(aval):
+    return str(getattr(aval, "dtype", "?"))
+
+
+def _is_weak(leaf):
+    aval = getattr(leaf, "aval", leaf)
+    return bool(getattr(aval, "weak_type", False))
+
+
+def _finding(rule, prog, message):
+    return Finding(rule=rule, path=prog.contract.declared_at, line=1,
+                   col=0, message=message,
+                   qualname=prog.contract.name, source=prog.config)
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+def check_tpu101(prog):
+    """TPU101 donation-actually-applied: every array leaf under the
+    declared donate_argnums must appear as a PINNED input/output alias
+    (`tf.aliasing_output`) in the lowered module. A `jax.buffer_donor`
+    marker is NOT enough — it is a free hint XLA may ignore, so the
+    paged pools could silently double their HBM footprint; a dropped
+    alias (output shape/dtype/sharding mismatch) is exactly the silent
+    regression this rule exists to catch."""
+    if not prog.contract.donate_argnums:
+        return []
+    pinned = prog.lowered_text.count("tf.aliasing_output")
+    donor = prog.lowered_text.count("jax.buffer_donor")
+    if pinned >= prog.donated_leaves:
+        return []
+    return [_finding(
+        "TPU101", prog,
+        f"declared donate_argnums="
+        f"{tuple(prog.contract.donate_argnums)} must pin "
+        f"{prog.donated_leaves} input/output aliases in the lowered "
+        f"module, found {pinned} (best-effort jax.buffer_donor "
+        f"markers: {donor}) — donation was dropped or demoted; the "
+        "donated buffers will be copied, not updated in place")]
+
+
+def check_tpu102(prog):
+    """TPU102 baked-large-constant: weights/tables captured by closure
+    are embedded in the program as literals — every retrace re-uploads
+    them and the compiled binary carries them forever. State must ride
+    as traced arguments (the TrainStep idiom)."""
+    cap = prog.contract.max_const_bytes
+    out = []
+    for shape, dtype, nbytes in prog.consts:
+        if nbytes > cap:
+            out.append(_finding(
+                "TPU102", prog,
+                f"constant {dtype}{list(shape)} ({nbytes} bytes) baked "
+                f"into the jaxpr exceeds max_const_bytes={cap} — "
+                "thread it through the program arguments instead of "
+                "closing over it"))
+    return out
+
+
+def check_tpu103(prog):
+    """TPU103 accumulation-dtype: a contraction (dot_general) or add-
+    reduction over sub-fp32 operands must accumulate at
+    `contract.accum_dtype` or wider (`preferred_element_type`) — bf16
+    accumulation silently cancels low-order bits (the PV-accumulation
+    bug class)."""
+    if prog.contract.accum_dtype not in _WIDE_FLOATS:
+        raise ValueError(
+            f"contract {prog.contract.name}: accum_dtype must be one "
+            f"of {_WIDE_FLOATS}")
+    out = []
+    counted = Counter()
+    for eqn in iter_eqns(prog.jaxpr):
+        name = eqn.primitive.name
+        if name not in _ACCUM_PRIMS:
+            continue
+        in_dts = [_dtype_name(v.aval) for v in eqn.invars
+                  if hasattr(v, "aval")]
+        if not any(d in _NARROW_FLOATS for d in in_dts):
+            continue
+        out_dt = _dtype_name(eqn.outvars[0].aval)
+        if out_dt in _WIDE_FLOATS:
+            continue
+        counted[(name, tuple(in_dts), out_dt)] += 1
+    for (name, in_dts, out_dt), n in sorted(counted.items()):
+        out.append(_finding(
+            "TPU103", prog,
+            f"{name} over {'/'.join(in_dts)} accumulates in {out_dt} "
+            f"({n} occurrence(s)) — pin preferred_element_type="
+            f"{prog.contract.accum_dtype} (accumulate wide, cast "
+            "once)"))
+    return out
+
+
+def check_tpu104(prog):
+    """TPU104 collective-budget: classify and count every mesh
+    collective in the step's jaxpr (recursively — shard_map bodies
+    included) against the contract's declared per-layer budget. An
+    unsharded (mp == 1) step is allowed NO collectives; a sharded step
+    gets `per_layer * num_layers + fixed` per kind. One accidental
+    extra all-gather in the decode path fails here instead of
+    stretching every serving iteration."""
+    actual = prog.collectives
+    budget = resolve_budget(prog.contract) if prog.mp > 1 else None
+    out = []
+    kinds = set(actual)
+    if budget is not None:
+        kinds |= set(budget.kinds())
+    for kind in sorted(kinds):
+        n = actual.get(kind, 0)
+        allowed = budget.allowed(kind, prog.num_layers) \
+            if budget is not None else 0
+        if n > allowed:
+            if budget is not None:
+                detail = (f"budget {allowed} = "
+                          f"{dict(budget.per_layer).get(kind, 0)}"
+                          f"/layer x {prog.num_layers} layers + "
+                          f"{dict(budget.fixed).get(kind, 0)} fixed")
+            elif prog.mp > 1:
+                detail = ("this step's contract declares no "
+                          "collective budget — none allowed at any "
+                          "mp")
+            else:
+                detail = "unsharded steps run no collectives"
+            out.append(_finding(
+                "TPU104", prog,
+                f"{kind} appears {n}x in the compiled step, allowed "
+                f"{allowed} ({detail})"))
+    return out
+
+
+def check_tpu105(prog):
+    """TPU105 trace-key instability: a python scalar (or weak-typed
+    array) in a program's signature makes the jit cache key depend on
+    promotion context — two call sites that agree on values can still
+    retrace. Engine dispatch must pass strong-typed arrays
+    (`jnp.int32(x)`, `jnp.asarray(np_arr)`), never bare python
+    numbers.
+
+    Boundary, stated plainly (the r9 etiquette): over the harvest
+    matrix this rule inspects the HARVESTED example args, which
+    mirror — but are not — the host scheduler's live dispatch; a
+    weak-typed leaf introduced only at a real dispatch site is caught
+    by the runtime `decode_traces == 1` probes (a per-value retrace
+    fails those gates loudly), while this rule pins the hazard class
+    itself via fixtures and guards every signature the harvester
+    feeds."""
+    out = []
+    for path, leaf in prog.arg_leaves:
+        if isinstance(leaf, (bool, int, float)):
+            out.append(_finding(
+                "TPU105", prog,
+                f"python {type(leaf).__name__} at arg {path} enters "
+                "the traced signature — pass a strong-typed array "
+                "(jnp.int32/asarray) so the trace-cache key is "
+                "stable"))
+        elif _is_weak(leaf):
+            out.append(_finding(
+                "TPU105", prog,
+                f"weak-typed leaf at arg {path} ({_dtype_name(getattr(leaf, 'aval', leaf))}) "
+                "— a python scalar leaked into the signature; cast it "
+                "explicitly"))
+    return out
+
+
+def check_tpu106(prog):
+    """TPU106 host-callback-in-compiled-step: a callback primitive
+    re-enters python mid-program — a host round-trip per dispatch on
+    the serving hot path (and a tracing hazard under donation)."""
+    if prog.contract.allow_host_callbacks:
+        return []
+    counts = prog.ops
+    out = []
+    for name in sorted(counts):
+        if name in CALLBACK_PRIMS or "callback" in name:
+            out.append(_finding(
+                "TPU106", prog,
+                f"host callback primitive `{name}` appears "
+                f"{counts[name]}x in the compiled step — hot-path "
+                "programs must not re-enter python"))
+    return out
+
+
+#: rule id -> (name, description, checker). TPU100 is the meta-rule
+#: for TRACE_BASELINE drift (reported by the harvester, like
+#: tpu-lint's TPU000 for unparseable files).
+TRACE_RULES = {
+    "TPU100": ("trace-drift",
+               "per-step op/collective/byte counts drifted from the "
+               "committed TRACE_BASELINE.json", None),
+    "TPU101": ("donation-not-applied",
+               "declared donate_argnums produced no pinned "
+               "input/output alias in the lowered module",
+               check_tpu101),
+    "TPU102": ("baked-large-constant",
+               "closure-captured array embedded in the jaxpr over the "
+               "contract's size threshold", check_tpu102),
+    "TPU103": ("accum-dtype",
+               "contraction/reduction over sub-fp32 operands without "
+               "fp32 accumulation", check_tpu103),
+    "TPU104": ("collective-budget",
+               "mesh collectives per compiled step exceed the "
+               "declared per-layer budget", check_tpu104),
+    "TPU105": ("trace-key-instability",
+               "python-scalar / weak-typed leaf in the program "
+               "signature", check_tpu105),
+    "TPU106": ("host-callback-in-step",
+               "host callback primitive inside a compiled hot-path "
+               "program", check_tpu106),
+}
+
+
+def all_trace_rule_ids():
+    return sorted(TRACE_RULES)
+
+
+def check_program(prog):
+    """Run every TPU1xx rule over one traced program. Contract waivers
+    mark findings suppressed (inline-justified, colocated with the
+    declaration) rather than dropping them — `--stats` still counts
+    them, mirroring tpu-lint suppression semantics."""
+    findings = []
+    for rule_id in all_trace_rule_ids():
+        check = TRACE_RULES[rule_id][2]
+        if check is None:
+            continue
+        found = check(prog)
+        why = prog.contract.waived(rule_id)
+        if why is not None:
+            for f in found:
+                f.suppressed = True
+        findings.extend(found)
+    return findings

@@ -135,7 +135,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.analysis.trace.contracts import TraceContract, \
+    register_contract
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import introspect
 from paddle_tpu.jit.api import bound_state, count_traces, dedup_params, \
     model_buffers
 from paddle_tpu.observability.metrics import LATENCY_BUCKETS, \
@@ -537,26 +540,36 @@ class GenerationEngine:
             self._tp_arrays = self._tp_specs = None
         donate = (jax.default_backend() != "cpu") if donate is None \
             else donate
-        self._donate_argnums = (1, 2) if donate else ()
+        # the one donation table both analyzers and the engine read:
+        # introspect.ENGINE_STEP_DONATION (tpu-lint TPU004 resolves
+        # the constants, tpu-verify TPU101 checks the lowered aliases)
+        self._donate_argnums = introspect.ENGINE_STEP_DONATE_ARGNUMS \
+            if donate else ()
         # with speculation on, the verify step IS the engine's decode
         # step: same probe, same donation, same traces==1 contract —
         # one program per (backend, K)
+        step_out = self._step_out_shardings(1)
         self._decode_pure = count_traces(
             self._build_verify() if k > 0 else self._build_decode())
         self._decode = jax.jit(self._decode_pure,
-                               donate_argnums=self._donate_argnums)
+                               donate_argnums=self._donate_argnums,
+                               out_shardings=step_out)
         self._prefill_pure = count_traces(
             self._build_prefill_chunk() if self.chunked_prefill
             else self._build_prefill())
         self._prefill = jax.jit(self._prefill_pure,
-                                donate_argnums=self._donate_argnums)
+                                donate_argnums=self._donate_argnums,
+                                out_shardings=step_out)
         # copy-on-write promotion: one tiny compiled gather/scatter,
         # traced src/dst so every COW reuses the same program
         cow = count_traces(copy_pool_block)
         cow.__name__ = "engine_cow_copy"
         self._cow_pure = cow
-        self._cow = jax.jit(cow,
-                            donate_argnums=(0, 1) if donate else ())
+        self._cow = jax.jit(
+            cow,
+            donate_argnums=introspect.ENGINE_COW_DONATE_ARGNUMS
+            if donate else (),
+            out_shardings=self._step_out_shardings(0))
         self._queues = {p: deque() for p in PRIORITY_CLASSES}
         self._slots = [None] * self.num_slots
         self._results = {}
@@ -688,6 +701,26 @@ class GenerationEngine:
         this."""
         if self._mp_axis is not None:
             self._tp_arrays, self._tp_specs = self._build_tp_state()
+
+    def _step_out_shardings(self, n_repl):
+        """Explicit out_shardings for a compiled step's jit: `n_repl`
+        replicated leading outputs (token ids) followed by the two
+        pool planes at the pool's sharding. None at mp=1 (jit infers).
+        At mp>1 this is LOAD-BEARING for donation, not decoration:
+        with inferred output shardings jax demotes donate_argnums to
+        best-effort `jax.buffer_donor` markers, while matching
+        explicit shardings let lowering PIN input/output aliases
+        (`tf.aliasing_output`) — the difference between the paged
+        pools provably updating in place and XLA merely being allowed
+        to. tpu-verify TPU101 gates on the pinned form."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        pool = NamedSharding(self.mesh, self.cache.pool_pspec())
+        repl = NamedSharding(self.mesh, P())
+        return (repl,) * n_repl + (pool, pool)
 
     def _shard_steps(self, fn, n_repl):
         """Wrap a compiled-step body in shard_map over the serving
@@ -1592,3 +1625,24 @@ class GenerationEngine:
                     "num_slots/max_model_len")
         out, self._results = self._results, {}
         return out
+
+
+# -- trace contracts (tpu-verify) ---------------------------------------
+# Declared HERE, next to the step builders, so the contract and the
+# program evolve in one diff. The harvester
+# (analysis/trace/harvest.py) constructs tiny engines over the full
+# {dense,pallas} x K x mp matrix and lowers THESE OBJECTS' jitted
+# steps; rules TPU101-TPU106 then enforce what is declared below.
+# Donation comes from the same introspect table the constructor
+# consumes; the collective budget is a lazy reference into models/gpt
+# (the module whose _mp_all_gather/_vocab_parallel_embed emit them).
+_GPT_SERVING_BUDGET = "paddle_tpu.models.gpt:GPT_SERVING_COLLECTIVES"
+
+for _step in ("engine_prefill", "engine_prefill_chunk",
+              "engine_decode_step", "engine_verify_step"):
+    register_contract(TraceContract(
+        name=_step,
+        declared_at="paddle_tpu/inference/engine.py",
+        donate_argnums=introspect.ENGINE_STEP_DONATION[_step],
+        collective_budget=_GPT_SERVING_BUDGET))
+del _step

@@ -103,12 +103,37 @@ NOT_TRACED_DECORATORS = {
 TRAINSTEP_DONATE_ARGNUMS = (0, 1, 2)
 ACCUM_DONATE_ARGNUMS = (0,)
 
+#: The serving engine's compiled steps all share ONE donation layout:
+#: every step body is `(state_arrays, kpool, vpool, *host_args)` and
+#: donates the two pool planes (positions 1, 2) so XLA updates the
+#: paged KV cache in place in HBM. The copy-on-write block-copy step
+#: is `(kpool, vpool, src, dst)` and donates positions 0, 1.
+ENGINE_STEP_DONATE_ARGNUMS = (1, 2)
+ENGINE_COW_DONATE_ARGNUMS = (0, 1)
+
+#: Donation layout of EVERY compiled engine program, by program name
+#: (the `__name__` the engine assigns each step body). This is the one
+#: source of truth both analyzers read: tpu-lint TPU004 resolves
+#: `donate_argnums=introspect.<NAME>` expressions through
+#: DONATION_CONSTANTS below, and tpu-verify TPU101 checks that the
+#: argnums declared HERE produce real input/output aliases in each
+#: program's lowered module — no magic `(1, 2)` literals anywhere.
+ENGINE_STEP_DONATION = {
+    "engine_prefill": ENGINE_STEP_DONATE_ARGNUMS,
+    "engine_prefill_chunk": ENGINE_STEP_DONATE_ARGNUMS,
+    "engine_decode_step": ENGINE_STEP_DONATE_ARGNUMS,
+    "engine_verify_step": ENGINE_STEP_DONATE_ARGNUMS,
+    "engine_cow_copy": ENGINE_COW_DONATE_ARGNUMS,
+}
+
 #: Named donation layouts by constant name — TPU004 resolves a
 #: `donate_argnums=introspect.<NAME>` expression through this table,
 #: so the framework's own jit sites stay visible to the rule.
 DONATION_CONSTANTS = {
     "TRAINSTEP_DONATE_ARGNUMS": TRAINSTEP_DONATE_ARGNUMS,
     "ACCUM_DONATE_ARGNUMS": ACCUM_DONATE_ARGNUMS,
+    "ENGINE_STEP_DONATE_ARGNUMS": ENGINE_STEP_DONATE_ARGNUMS,
+    "ENGINE_COW_DONATE_ARGNUMS": ENGINE_COW_DONATE_ARGNUMS,
 }
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,7 @@ import numpy as np
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
+from paddle_tpu.analysis.trace.contracts import CollectiveBudget
 from paddle_tpu.ops import manipulation as mp
 
 
@@ -31,6 +32,20 @@ def _mp_degree():
         return get_hybrid_communicate_group().axis_size("mp")
     except Exception:
         return 1
+
+
+# Collective budget of ONE tensor-parallel serving step of this model
+# (tpu-verify TPU104; declared here because the helpers right below
+# are the only places serving collectives come from). Per transformer
+# layer: _attn_out all-gathers twice (head reassembly + out_proj
+# columns) and the MLP twice (fc1 + fc2 columns) = 4; fixed: one
+# lm-head logits all-gather + one vocab-parallel-embedding psum. An
+# accidental fifth per-layer gather (or a brand-new collective kind)
+# fails the trace gate instead of stretching every decode step.
+GPT_SERVING_COLLECTIVES = CollectiveBudget(
+    per_layer=(("all_gather", 4),),
+    fixed=(("all_gather", 1), ("psum", 1)),
+)
 
 
 def _mp_all_gather(t, mp_axis):

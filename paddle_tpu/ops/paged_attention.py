@@ -86,6 +86,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.analysis.trace.contracts import TraceContract, \
+    register_contract
+from paddle_tpu.jit import introspect
+
 from .dispatch import apply, as_tensor
 
 __all__ = ["paged_attention_step", "paged_verify_window",
@@ -450,6 +454,18 @@ def paged_prefill_chunk(q, k, v, kpool, vpool, layer, block_row, start,
 
     return apply("paged_prefill_chunk", fn, q, k, v, kpool, vpool,
                  block_row, start, plen)
+
+
+# tpu-verify contract for the engine's compiled COW step (the op
+# right below): donates both pools (introspect is the shared table),
+# runs no collectives at any mp (plain jit over the sharded pools —
+# the copy is row-local per shard), and must never bake constants or
+# call back to host. Declared here because this module owns the step
+# body.
+register_contract(TraceContract(
+    name="engine_cow_copy",
+    declared_at="paddle_tpu/ops/paged_attention.py",
+    donate_argnums=introspect.ENGINE_COW_DONATE_ARGNUMS))
 
 
 def copy_pool_block(kpool, vpool, src, dst):

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""tpu-verify — jaxpr/StableHLO trace-contract checker.
+
+Abstractly traces every registered compiled engine program over the
+full serving matrix ({dense,pallas} x K in {0,4} x mp in {1,2}) on
+CPU — no device execution — and enforces the TPU1xx trace contracts
+(donation aliasing, baked constants, accumulation dtype, collective
+budget, trace-key stability, host callbacks) plus the committed
+TRACE_BASELINE.json drift snapshot.
+
+Usage:
+    python tools/tpu_verify.py
+    python tools/tpu_verify.py --stats --format=json
+    python tools/tpu_verify.py --list-rules
+    python tools/tpu_verify.py --write-trace-baseline
+
+See README "Trace verification" for the rule table and contract
+declaration etiquette. Runs as a tier-1 gate
+(tests/test_tpu_verify_gate.py).
+"""
+import os
+import sys
+
+# abstract tracing on CPU is sufficient (DESIGN_DECISIONS r13) and the
+# mp=2 configs need a virtual device mesh — both must be pinned BEFORE
+# the first jax backend init
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.trace.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

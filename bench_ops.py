@@ -103,6 +103,7 @@ SUITE_ROWS = (
     "paged_attention_decode_sweep", "gpt_engine_offered_load_pallas",
     "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill",
     "gpt_engine_speculative", "gpt_engine_offered_load_mp2",
+    "gpt_engine_offered_load_int8",
 )
 
 
@@ -204,6 +205,8 @@ def suite():
     cases["gpt_engine_speculative"] = _engine_speculative_case()
     cases["gpt_engine_offered_load_mp2"] = _engine_offered_load_case(
         mp_degree=2)
+    cases["gpt_engine_offered_load_int8"] = _engine_offered_load_case(
+        kv_dtype="int8")
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -294,13 +297,18 @@ def _paged_attention_sweep_case(num_slots=8, heads=16, head_dim=128,
     the pallas kernel must track it with a lower slope (per-slot
     block streaming instead of a batch gather). Headline `ms` is the
     pallas full-context time — the fused kernel is what this row
-    tracks; the per-backend curves ride in the record. Lazy-built like
-    every heavy inference row; tests call it at a tiny shape (pallas
-    runs interpreted off-TPU)."""
+    tracks; the per-backend curves ride in the record. The int8
+    curves (`<backend>_int8_ms_by_ctx`, PR 11) run the SAME sweep
+    against int8 per-block-quantized pools + scales — the
+    streamed-bytes halving the quantized KV cache claims, visible as
+    a flatter dense slope and a cheaper pallas walk on TPU.
+    Lazy-built like every heavy inference row; tests call it at a
+    tiny shape (pallas runs interpreted off-TPU)."""
 
     def run_bench():
         import paddle_tpu  # noqa: F401  (registers ops)
-        from paddle_tpu.ops.paged_attention import paged_attention_step
+        from paddle_tpu.ops.paged_attention import (KV_QUANT_EPS,
+                                                    paged_attention_step)
 
         dt = dtype or jnp.bfloat16
         max_blocks = max(max_model_len // block_size, 1)
@@ -310,6 +318,20 @@ def _paged_attention_sweep_case(num_slots=8, heads=16, head_dim=128,
                       seed=seed)
         vpool = _rand((L, num_blocks, block_size, heads, head_dim), dt,
                       seed=seed + 1)
+
+        def quantize_pool(pool):
+            arr = pool.astype(jnp.float32)
+            s = jnp.maximum(
+                jnp.max(jnp.abs(arr), axis=(2, 3, 4)) / 127.0,
+                KV_QUANT_EPS)                        # [L, blocks]
+            q = jnp.clip(jnp.round(arr / s[:, :, None, None, None]),
+                         -127, 127).astype(jnp.int8)
+            return q, s
+
+        kq, ks = quantize_pool(kpool)
+        vq, vs = quantize_pool(vpool)
+        kpool_q, vpool_q = kq, vq
+        scales_q = jnp.stack([ks, vs], axis=-1)      # [L, blocks, 2]
         # disjoint per-slot tables covering the whole budget; the sweep
         # only moves `positions`, so every backend sees the same layout
         tables = 1 + np.arange(num_slots * max_blocks, dtype=np.int32) \
@@ -319,6 +341,7 @@ def _paged_attention_sweep_case(num_slots=8, heads=16, head_dim=128,
         v_new = _rand((num_slots, 1, heads, head_dim), dt, seed=seed + 4)
 
         curves = {b: {} for b in backends}
+        curves_q = {b: {} for b in backends}
         for ctx in ctx_lengths:
             positions = np.full(num_slots, ctx - 1, np.int32)
             for b in backends:
@@ -335,21 +358,49 @@ def _paged_attention_sweep_case(num_slots=8, heads=16, head_dim=128,
                     return out._array
                 ms = _timeit(step, q, k_new, v_new)
                 curves[b][str(ctx)] = round(ms, 4)
+
+                def step_q(qa, ka, va, _b=b, _pos=positions):
+                    out, _, _, _ = paged_attention_step(
+                        qa, ka, va, kpool_q, vpool_q, 0, tables,
+                        _pos, backend=_b, scales=scales_q)
+                    return out._array
+                ms = _timeit(step_q, q, k_new, v_new)
+                curves_q[b][str(ctx)] = round(ms, 4)
         head = "pallas" if "pallas" in curves else backends[0]
         rec = {"ms": curves[head][str(ctx_lengths[-1])],
                "max_model_len": max_model_len,
                "block_size": block_size}
         for b in backends:
             rec[f"{b}_ms_by_ctx"] = curves[b]
+            rec[f"{b}_int8_ms_by_ctx"] = curves_q[b]
         return rec
 
     return run_bench
 
 
+# Documented tolerance budget for int8 serving (ISSUE 11): the
+# quantized engine's greedy token streams must agree with the fp
+# engine's on at least this fraction of generated tokens over the
+# standard mixed trace (README "Quantized serving" states the policy;
+# tests/test_engine_quantized.py enforces it at CI scale).
+INT8_TOKEN_PARITY_MIN = 0.90
+
+
+def _token_match_fraction(ref_outs, got_outs):
+    """Fraction of positionally matching tokens across two runs'
+    aligned output lists (prompt + generated per request)."""
+    match = total = 0
+    for a, b in zip(ref_outs, got_outs):
+        n = max(len(a), len(b))
+        total += n
+        match += sum(x == y for x, y in zip(a, b))
+    return match / max(total, 1)
+
+
 def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
                               block_size=16, prefill_buckets=None,
                               seed=0, attention_backend=None,
-                              mp_degree=None):
+                              mp_degree=None, kv_dtype=None):
     """Engine-level offered-load row: the continuous-batching engine
     (paged KV cache + slot scheduler, inference/engine.py) serving a
     mixed trace of prompts/output lengths; the metric is AGGREGATE new
@@ -372,7 +423,14 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
     mesh (`gpt_engine_offered_load_mp2`): the row first serves at mp=1
     for the reference outputs + tokens/s, then at mp_degree, and
     ASSERTS the outputs token-identical — the headline numbers are the
-    sharded engine's."""
+    sharded engine's.
+    `kv_dtype='int8'` is the quantized serving row
+    (`gpt_engine_offered_load_int8`): the same trace served fp first
+    (reference outputs + tokens/s + pool bytes), then with the int8
+    per-block-scaled KV cache AND int8 weights; outputs must match
+    within the documented tolerance (INT8_TOKEN_PARITY_MIN) and the
+    record carries both tokens/s, both pool-byte footprints, and the
+    measured match fraction."""
 
     def run_bench():
         import time
@@ -410,12 +468,24 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
             b for b in (32, 64, 128, 256, cfg.max_seq_len)
             if b <= cfg.max_seq_len)
 
-        def build(mp):
+        def build(mp, quant=False):
+            qkw = dict(kv_dtype="int8", weight_dtype="int8") \
+                if quant else {}
             engine = GenerationEngine(model, num_slots=num_slots,
                                       block_size=block_size,
                                       prefill_buckets=buckets,
                                       attention_backend=attention_backend,
-                                      mp_degree=mp)
+                                      mp_degree=mp, **qkw)
+            if not quant and (engine.kv_dtype is not None
+                              or engine.weight_dtype is not None):
+                # either env knob would silently quantize the fp
+                # reference too, making the parity numbers a lie
+                raise RuntimeError(
+                    "the fp reference engine resolved kv_dtype="
+                    f"{engine.kv_dtype!r} / weight_dtype="
+                    f"{engine.weight_dtype!r} (is PADDLE_SERVE_KV_DTYPE"
+                    " or PADDLE_SERVE_WEIGHT_DTYPE set?) — unset them "
+                    "to run this row")
             if mp and engine.mp_degree != mp:
                 # a row NAMED for an mp degree must never record an
                 # env-overridden mesh's numbers under that name
@@ -458,7 +528,28 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
             return dt, new_toks, [list(map(int, out[i])) for i in ids]
 
         mp_extra = {}
-        if mp_degree:
+        if kv_dtype:
+            if kv_dtype != "int8":
+                raise ValueError(
+                    f"kv_dtype={kv_dtype!r}: only 'int8' is benched")
+            ref_engine = build(None)
+            dt1, toks1, outs1 = serve(ref_engine)
+            fp_bytes = ref_engine.cache.pool_nbytes()
+            engine = build(None, quant=True)
+            dt, new_toks, outs = serve(engine)
+            match = _token_match_fraction(outs1, outs)
+            assert match >= INT8_TOKEN_PARITY_MIN, \
+                (f"int8 outputs match only {match:.3f} of fp tokens "
+                 f"(tolerance budget {INT8_TOKEN_PARITY_MIN})")
+            q_bytes = engine.cache.pool_nbytes()
+            mp_extra = {"kv_dtype": "int8", "weight_dtype": "int8",
+                        "tokens_per_s_fp": round(toks1 / dt1),
+                        "token_match_fraction": round(match, 4),
+                        "pool_bytes_fp": fp_bytes,
+                        "pool_bytes_int8": q_bytes,
+                        "pool_bytes_ratio": round(q_bytes / fp_bytes,
+                                                  4)}
+        elif mp_degree:
             if mp_degree < 2:
                 raise ValueError(
                     f"mp_degree={mp_degree}: the sharded row compares "

@@ -40,6 +40,12 @@ DEFAULT_TRACE_BASELINE = os.path.join(_REPO_ROOT, "TRACE_BASELINE.json")
 BACKENDS = ("dense", "pallas")
 SPEC_KS = (0, 4)
 MP_DEGREES = (1, 2)
+#: None = today's fp serving; "int8" = the quantized configs (int8
+#: per-block-scaled KV pools AND int8 weights through the state seam)
+#: — every contract is proven over both, so a quantization regression
+#: (dropped donation, bf16 accumulation on a dequantized matmul, an
+#: unbudgeted collective in the scale fold) fails the same gate.
+KV_DTYPES = (None, "int8")
 
 #: Tiny-but-structurally-real harvest geometry: 2 layers so per-layer
 #: collective budgets multiply, 4 heads so mp=2 head-sharding divides,
@@ -49,8 +55,8 @@ TINY = dict(vocab=64, hidden=32, layers=2, heads=4, seq=32,
 
 
 def default_matrix():
-    return tuple((b, k, mp) for b in BACKENDS for k in SPEC_KS
-                 for mp in MP_DEGREES)
+    return tuple((b, k, mp, kv) for b in BACKENDS for k in SPEC_KS
+                 for mp in MP_DEGREES for kv in KV_DTYPES)
 
 
 def _require_devices(mp):
@@ -100,41 +106,64 @@ def _trace_one(name, config, pure_fn, jitted, args, mp, num_layers):
 
 def harvest(matrix=None):
     """-> list[TracedProgram] over the full contract matrix: one
-    chunked engine per (backend, K, mp) contributes its
-    decode-or-verify step (8 programs — where the backends/K
+    chunked engine per (backend, K, mp, kv_dtype) contributes its
+    decode-or-verify step (16 programs — where the backends/K/kv
     diverge); the backend/K-invariant programs (chunked prefill,
     legacy bucketed prefill from a bucketed engine, COW block-copy)
-    harvest once per mp (6 more)."""
+    harvest once per (mp, kv_dtype) (12 more). The kv="int8" configs
+    serve int8 per-block-scaled KV AND int8 weights — the full
+    quantized serving shape."""
     import jax.numpy as jnp
     import numpy as np
 
     from paddle_tpu.inference.engine import GenerationEngine
 
-    matrix = default_matrix() if matrix is None else tuple(matrix)
-    for _, _, mp in matrix:
+    matrix = default_matrix() if matrix is None else tuple(
+        m if len(m) == 4 else (*m, None) for m in matrix)
+    for _, _, mp, _ in matrix:
         _require_devices(mp)
     model = _build_model()
     L = model.config.num_layers
     programs = []
-    for backend, K, mp in matrix:
-        config = f"{backend},K={K},mp={mp}"
-        eng = GenerationEngine(
+
+    def check_knobs(engine, kv):
+        # serve-time env overrides win over ctor args by design — but
+        # a leaked PADDLE_SERVE_KV_DTYPE/PADDLE_SERVE_WEIGHT_DTYPE
+        # would silently harvest (and baseline) a quantized program
+        # under an fp config label, or feed fp-shaped step args to a
+        # quantized signature. Fail loudly instead.
+        if (engine.kv_dtype, engine.weight_dtype) != (kv, kv):
+            raise RuntimeError(
+                f"harvest config kv={kv!r} resolved kv_dtype="
+                f"{engine.kv_dtype!r}/weight_dtype="
+                f"{engine.weight_dtype!r} (is PADDLE_SERVE_KV_DTYPE "
+                "or PADDLE_SERVE_WEIGHT_DTYPE set?) — unset them to "
+                "harvest")
+        return engine
+
+    for backend, K, mp, kv in matrix:
+        tag = ",int8" if kv else ""
+        config = f"{backend},K={K},mp={mp}{tag}"
+        quant = dict(kv_dtype=kv, weight_dtype=kv) if kv else {}
+        eng = check_knobs(GenerationEngine(
             model, num_slots=TINY["slots"],
             block_size=TINY["block_size"], attention_backend=backend,
-            spec_decode_k=K, mp_degree=mp, donate=True)
+            spec_decode_k=K, mp_degree=mp, donate=True, **quant), kv)
         S, MB, C = eng.num_slots, eng.max_blocks, eng.prefill_chunk
         state = eng._state_arrays()
         kp, vp = eng.cache.kpool, eng.cache.vpool
+        sc = (eng.cache.scales,) if kv else ()
         tokens = jnp.asarray(np.zeros((S, K + 1), np.int32))
         positions = jnp.asarray(np.zeros(S, np.int32))
         tables = jnp.asarray(np.zeros((S, MB), np.int32))
         if K > 0:
             dlens = jnp.asarray(np.zeros(S, np.int32))
-            step_args = (state, kp, vp, tokens, positions, dlens,
+            step_args = (state, kp, vp, *sc, tokens, positions, dlens,
                          tables)
             step_name = "engine_verify_step"
         else:
-            step_args = (state, kp, vp, tokens, positions, tables)
+            step_args = (state, kp, vp, *sc, tokens, positions,
+                         tables)
             step_name = "engine_decode_step"
         programs.append(_trace_one(
             step_name, config, eng._decode_pure, eng._decode,
@@ -142,40 +171,42 @@ def harvest(matrix=None):
         # the prefill programs and the COW copy are backend- and
         # K-invariant today (paged_prefill_chunk has no backend seam;
         # the decode/verify steps are where the backends diverge), so
-        # they harvest ONCE per mp — if a prefill backend ever grows,
-        # widen this to the full config string
+        # they harvest ONCE per (mp, kv_dtype) — if a prefill backend
+        # ever grows, widen this to the full config string
         if K == 0 and backend == "dense":
             chunk_tokens = jnp.asarray(np.zeros((1, C), np.int32))
             row = jnp.asarray(np.zeros(MB, np.int32))
             programs.append(_trace_one(
-                "engine_prefill_chunk", f"mp={mp}", eng._prefill_pure,
-                eng._prefill,
-                (state, kp, vp, chunk_tokens, jnp.int32(0),
+                "engine_prefill_chunk", f"mp={mp}{tag}",
+                eng._prefill_pure, eng._prefill,
+                (state, kp, vp, *sc, chunk_tokens, jnp.int32(0),
                  jnp.int32(TINY["block_size"] + 1), row),
                 mp, L))
             bucket = TINY["seq"] // 2
-            beng = GenerationEngine(
+            beng = check_knobs(GenerationEngine(
                 model, num_slots=TINY["slots"],
                 block_size=TINY["block_size"],
                 attention_backend=backend,
                 prefill_buckets=(bucket, TINY["seq"]), mp_degree=mp,
-                donate=True)
+                donate=True, **quant), kv)
             btok = jnp.asarray(np.zeros((1, bucket), np.int32))
             # every arg from the BUCKETED engine itself — if its
             # geometry/state layout ever diverges from the chunked
             # engine's, the harvested signature must follow the real
             # program, not a lookalike
+            bsc = (beng.cache.scales,) if kv else ()
             brow = jnp.asarray(np.zeros(beng.max_blocks, np.int32))
             programs.append(_trace_one(
-                "engine_prefill", f"mp={mp}", beng._prefill_pure,
+                "engine_prefill", f"mp={mp}{tag}", beng._prefill_pure,
                 beng._prefill,
                 (beng._state_arrays(), beng.cache.kpool,
-                 beng.cache.vpool, btok, jnp.int32(bucket - 2), brow),
+                 beng.cache.vpool, *bsc, btok, jnp.int32(bucket - 2),
+                 brow),
                 mp, L))
+            cow_args = (kp, vp, jnp.int32(1), jnp.int32(2), *sc)
             programs.append(_trace_one(
-                "engine_cow_copy", f"mp={mp}", eng._cow_pure,
-                eng._cow, (kp, vp, jnp.int32(1), jnp.int32(2)),
-                mp, L))
+                "engine_cow_copy", f"mp={mp}{tag}", eng._cow_pure,
+                eng._cow, cow_args, mp, L))
     return programs
 
 

@@ -44,7 +44,18 @@ _ACCUM_PRIMS = ("dot_general", "reduce_sum")
 _NARROW_FLOATS = ("bfloat16", "float16", "float8_e4m3fn",
                   "float8_e5m2")
 
+#: Narrow integer dtypes the quantized serving paths contract over
+#: (int8 KV / int8 weights): an int8 dot_general must accumulate in
+#: fp32 (the contract's accum_dtype) or int32 — accumulating in a
+#: narrow float (int8 -> bf16) or staying int8 loses exactly the bits
+#: quantization already spent.
+_NARROW_INTS = ("int8", "uint8", "int4", "uint4")
+
 _WIDE_FLOATS = ("float32", "float64")
+
+#: Acceptable accumulators for narrow-INT operands: wide floats plus
+#: the standard exact integer accumulators.
+_WIDE_INT_ACCUMS = _WIDE_FLOATS + ("int32", "int64")
 
 
 @dataclass
@@ -226,7 +237,10 @@ def check_tpu103(prog):
     reduction over sub-fp32 operands must accumulate at
     `contract.accum_dtype` or wider (`preferred_element_type`) — bf16
     accumulation silently cancels low-order bits (the PV-accumulation
-    bug class)."""
+    bug class). Narrow-INT operands (the int8 quantized-serving
+    paths) must accumulate in a wide float or an exact int32/int64:
+    int8 operands with fp32 accumulation pass, int8 -> bf16 (or a
+    dot that stays int8) fires."""
     if prog.contract.accum_dtype not in _WIDE_FLOATS:
         raise ValueError(
             f"contract {prog.contract.name}: accum_dtype must be one "
@@ -239,10 +253,13 @@ def check_tpu103(prog):
             continue
         in_dts = [_dtype_name(v.aval) for v in eqn.invars
                   if hasattr(v, "aval")]
-        if not any(d in _NARROW_FLOATS for d in in_dts):
+        narrow_int = any(d in _NARROW_INTS for d in in_dts)
+        if not narrow_int \
+                and not any(d in _NARROW_FLOATS for d in in_dts):
             continue
         out_dt = _dtype_name(eqn.outvars[0].aval)
-        if out_dt in _WIDE_FLOATS:
+        if out_dt in (_WIDE_INT_ACCUMS if narrow_int
+                      else _WIDE_FLOATS):
             continue
         counted[(name, tuple(in_dts), out_dt)] += 1
     for (name, in_dts, out_dt), n in sorted(counted.items()):

@@ -112,6 +112,24 @@ per mesh shape (`decode_traces == 1` per (backend, K, mp)) and the
 sharded pools stay donated. CPU CI runs the real mp=2/mp=4 program on
 a virtual device mesh (`--xla_force_host_platform_device_count`).
 
+Quantized serving (PR 11): decode's other wall is the BYTES — every
+step re-streams the live KV and the weights. `kv_dtype='int8'` (env
+`PADDLE_SERVE_KV_DTYPE`) stores the paged pools as int8 codes plus a
+`[layers, blocks, 2]` per-block K/V scale array threaded through
+every compiled step beside the pools: quant-on-write grows and
+requantizes only the written (engine-private) block's grid, dequant
+is fused into both backends' streamed-block matmuls (fp32 online
+softmax unchanged), COW copies scale rows with blocks, and the
+prefix cache shares them by block id — so pool bytes halve vs bf16
+and warm/speculative runs replay exactly. `weight_dtype='int8'` (env
+`PADDLE_SERVE_WEIGHT_DTYPE`, re-snapshot via `quantize_weights()`)
+serves qkv/out/fc1/fc2 as (int8, per-channel scale) pairs
+dequantized inside the step to the compute dtype — int8 in HBM, fp32
+accumulation (tpu-verify TPU103). Both knobs off is BIT-identical to
+the unquantized engine; quantized output is tolerance-gated against
+the fp path (see README "Quantized serving"), token-exact across
+mesh shapes (per-block grids pmax-fold at mp>1) and across backends.
+
 Serving telemetry (PR 2): every engine carries a metrics registry
 (`engine.metrics`, observability tier) — TTFT/TPOT histograms, queue/
 slot/pool gauges with a high-water mark, admission/finish/stall
@@ -173,15 +191,27 @@ class PagedKVCache:
     was."""
 
     def __init__(self, num_layers, num_blocks, block_size, num_heads,
-                 head_dim, dtype=jnp.float32, mesh=None, mp_axis="mp"):
+                 head_dim, dtype=jnp.float32, mesh=None, mp_axis="mp",
+                 kv_dtype=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is the null "
                              "block)")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (fp pools) or 'int8', got "
+                f"{kv_dtype!r}")
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
+        # int8 per-block-scaled KV (PR 11): the pools store int8 codes
+        # and `self.scales` `[layers, num_blocks, 2]` f32 carries each
+        # block's symmetric K/V absmax grid (column 0 = K, 1 = V),
+        # threaded through every compiled step alongside the pools.
+        # `dtype` stays the MODEL compute dtype the attention output
+        # casts back to; pool_spec() is still the one layout truth.
+        self.kv_dtype = kv_dtype
         self.dtype = dtype
         # tensor-parallel serving: pools sharded on the HEADS axis over
         # the mesh's mp axis (per-shard planes [L, B, bs, H/mp, D]);
@@ -204,6 +234,23 @@ class PagedKVCache:
         else:
             self.kpool = jnp.zeros(shape, dt)
             self.vpool = jnp.zeros(shape, dt)
+        if self.kv_dtype == "int8":
+            from paddle_tpu.ops.paged_attention import KV_QUANT_EPS
+
+            self._scale_eps = KV_QUANT_EPS
+            scales = jnp.full(self.scale_spec()[0], KV_QUANT_EPS,
+                              self.scale_spec()[1])
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                # per-(layer, block) grids are GLOBAL across the
+                # head-sharded pools (the steps pmax-fold the shards'
+                # absmax), so the array replicates on the mesh
+                scales = jax.device_put(
+                    scales, NamedSharding(mesh, PartitionSpec()))
+            self.scales = scales
+        else:
+            self.scales = None
         # LIFO free list: recently-freed (cache-warm) blocks reused first
         self._free = list(range(num_blocks - 1, 0, -1))
         self._ref = [0] * self.num_blocks
@@ -219,9 +266,28 @@ class PagedKVCache:
         `([layers, blocks, block_size, heads, head_dim], dtype)`: the
         sharded and unsharded constructors (and anything rebuilding a
         pool-shaped buffer) derive it from here, so the two layouts
-        cannot drift."""
+        cannot drift. Under `kv_dtype='int8'` the dtype is int8 (the
+        codes); the per-block grids live in `scale_spec()`."""
+        dt = jnp.int8 if self.kv_dtype == "int8" else self.dtype
         return ((self.num_layers, self.num_blocks, self.block_size,
-                 self.num_heads, self.head_dim), self.dtype)
+                 self.num_heads, self.head_dim), dt)
+
+    def scale_spec(self):
+        """Layout of the int8 pools' per-block scale array:
+        `([layers, blocks, 2], float32)` — column 0 is the K grid,
+        column 1 the V grid. None for fp pools."""
+        if self.kv_dtype != "int8":
+            return None
+        return ((self.num_layers, self.num_blocks, 2), jnp.float32)
+
+    def pool_nbytes(self):
+        """Total bytes of the paged KV state: both pool planes plus
+        (int8 mode) the per-block scale array — the number the
+        capacity claim and the `engine_pool_bytes` gauge report."""
+        n = int(self.kpool.nbytes) + int(self.vpool.nbytes)
+        if self.scales is not None:
+            n += int(self.scales.nbytes)
+        return n
 
     def pool_pspec(self):
         """PartitionSpec sharding the pools' HEADS axis over the mp
@@ -264,6 +330,12 @@ class PagedKVCache:
             got.append(block)
         for b in got:
             self._ref[b] = 1
+        if got and self.scales is not None:
+            # a recycled block's grid belongs to its PREVIOUS tenant:
+            # reset to the floor so the new owner's first write sets a
+            # fresh grid instead of quantizing against stale scales
+            self.scales = self.scales.at[:, np.asarray(got), :].set(
+                self._scale_eps)
         return got
 
     def free(self, blocks):
@@ -424,7 +496,8 @@ class GenerationEngine:
                  registry=None, attention_backend=None,
                  prefill_chunk="auto", enable_prefix_cache=None,
                  max_queue=None, spec_decode_k=0, drafter=None,
-                 mesh=None, mp_degree=None):
+                 mesh=None, mp_degree=None, kv_dtype=None,
+                 weight_dtype=None):
         from paddle_tpu.ops.paged_attention import (copy_pool_block,
                                                     resolve_backend)
 
@@ -474,6 +547,17 @@ class GenerationEngine:
                              "(bucketed prefill always recomputes from "
                              "position 0)")
         self.enable_prefix_cache = bool(enable_prefix_cache)
+        # quantized serving (PR 11): kv_dtype='int8' stores the paged
+        # pools as int8 codes + per-block scales (halves the HBM bytes
+        # every decode step streams and doubles effective prefix-cache
+        # capacity); weight_dtype='int8' serves qkv/out/fc1/fc2 as
+        # int8 + per-channel scales, dequantized inside the compiled
+        # steps. Env overrides win (deploy-time knobs, like the
+        # backend); None keeps today's fp path BIT-identical.
+        self.kv_dtype = self._resolve_dtype_knob(
+            "PADDLE_SERVE_KV_DTYPE", kv_dtype)
+        self.weight_dtype = self._resolve_dtype_knob(
+            "PADDLE_SERVE_WEIGHT_DTYPE", weight_dtype)
         # default pool covers every slot at full context (+ null block):
         # correctness-first; serving deployments size it to live-context
         # expectations and lean on the stall/retry path under pressure
@@ -482,7 +566,8 @@ class GenerationEngine:
             int(num_blocks or 1 + self.num_slots * self.max_blocks),
             self.block_size, cfg.num_heads,
             cfg.hidden_size // cfg.num_heads,
-            dtype=model.gpt.wte.weight._array.dtype, mesh=self.mesh)
+            dtype=model.gpt.wte.weight._array.dtype, mesh=self.mesh,
+            kv_dtype=self.kv_dtype)
         if self.chunked_prefill:
             self.prefill_buckets = ()
         else:
@@ -529,15 +614,24 @@ class GenerationEngine:
         # args, so weight updates are visible without retracing
         self._state = dedup_params(list(model.parameters())) + \
             model_buffers(model)
+        # int8 weight serving: qkv/out/fc1/fc2 ride the steps as
+        # (int8 codes, per-output-channel scale) pairs and dequantize
+        # INSIDE the compiled step (fp32 accumulation pinned by
+        # tpu-verify TPU103) — the per-step HBM weight read shrinks to
+        # the int8 bytes. `_qmeta[i]` is the entry's dequant target
+        # dtype (None = unquantized); quantize_weights() (re)builds
+        # the snapshot.
+        self._wq_plan = self._weight_quant_plan() \
+            if self.weight_dtype == "int8" else {}
+        self._qmeta = [None] * len(self._state)
+        self._q_arrays = None
         # tensor parallel: a serving-time SNAPSHOT of the state, each
         # array device_put onto the mesh with its Megatron
         # column-parallel spec (qkv weights head-grouped first); the
         # specs double as the shard_map in_specs. refresh_weights()
         # re-snapshots after a live weight update.
-        if self._mp_axis is not None:
-            self._tp_arrays, self._tp_specs = self._build_tp_state()
-        else:
-            self._tp_arrays = self._tp_specs = None
+        self._tp_arrays = self._tp_specs = None
+        self.quantize_weights()
         donate = (jax.default_backend() != "cpu") if donate is None \
             else donate
         # the one donation table both analyzers and the engine read:
@@ -638,6 +732,87 @@ class GenerationEngine:
                     "column-shard the MLP")
         self._mp_axis = "mp" if self.mp_degree > 1 else None
 
+    @staticmethod
+    def _resolve_dtype_knob(env_name, requested):
+        """Resolve a quantization knob: env override wins, '' means
+        unset, only None/'int8' are valid (the fp path is the absence
+        of the knob, not a named dtype)."""
+        env = os.environ.get(env_name)
+        if env not in (None, ""):
+            requested = env
+        if requested in (None, ""):
+            return None
+        if requested != "int8":
+            raise ValueError(
+                f"{env_name}/ctor value must be unset or 'int8', got "
+                f"{requested!r}")
+        return "int8"
+
+    # -- int8 weight serving ----------------------------------------------
+    def _weight_quant_plan(self):
+        """id(state tensor) -> (scale_transform, scale PartitionSpec)
+        for every weight served int8: the attention qkv/out and MLP
+        fc1/fc2 matmuls (the per-step weight-read floor), per-OUTPUT-
+        channel absmax scales via quantization.quantize_absmax(axis=1).
+        Embeddings/norms/biases stay fp — the logit head's quality is
+        the tolerance budget's scarcest resource. The scale transform
+        mirrors `_tp_plan`'s qkv head-grouping so scales shard exactly
+        like their weights."""
+        from jax.sharding import PartitionSpec as P
+
+        D = self.model.config.hidden_size // self.model.config.num_heads
+
+        def qkv_s(s):                  # [1, 3H] -> [1, heads, 3, D]
+            return s.reshape(1, 3, -1, D).transpose(0, 2, 1, 3)
+
+        plan = {}
+        for blk in self.model.gpt.blocks:
+            attn, mlp = blk.attn, blk.mlp
+            plan[id(attn.qkv_proj.weight)] = (qkv_s,
+                                              P(None, "mp", None, None))
+            for lin in (attn.out_proj, mlp.fc1, mlp.fc2):
+                plan[id(lin.weight)] = (None, P(None, "mp"))
+        return plan
+
+    def quantize_weights(self):
+        """(Re)build the served weight snapshot: the tensor-parallel
+        mesh placement (mp > 1) and/or the int8 quantized state
+        (weight_dtype='int8'). Called by the constructor and by
+        `refresh_weights()`; a no-op for the plain fp mp=1 engine,
+        which reads the live tensors every step."""
+        if self._mp_axis is not None:
+            self._tp_arrays, self._tp_specs = self._build_tp_state()
+        elif self.weight_dtype == "int8":
+            self._q_arrays = self._build_quant_state()
+
+    def _build_quant_state(self):
+        """mp=1 int8 snapshot: state entries become (int8, scale)
+        pairs per `_weight_quant_plan`, everything else rides live."""
+        from paddle_tpu.quantization import quantize_absmax
+
+        arrays = []
+        for i, t in enumerate(self._state):
+            if id(t) in self._wq_plan:
+                q, s = quantize_absmax(t._array, axis=1)
+                arrays.append((q, s))
+                self._qmeta[i] = t._array.dtype
+            else:
+                arrays.append(t._array)
+        return arrays
+
+    def _materialize_state(self, state_arrays):
+        """Inside a compiled step: dequantize the (int8, scale) state
+        entries straight to their compute dtype (the dequantize(dtype=)
+        seam) so the matmuls run fp with fp32 accumulation while HBM
+        holds — and the step reads — int8 bytes."""
+        if not self._wq_plan:
+            return state_arrays
+        from paddle_tpu.quantization import dequantize
+
+        return [dequantize(e[0], e[1], dtype=meta)
+                if meta is not None else e
+                for e, meta in zip(state_arrays, self._qmeta)]
+
     def _tp_plan(self):
         """id(state tensor) -> (transform, PartitionSpec): the Megatron
         column-parallel serving layout. qkv weights are re-grouped
@@ -684,9 +859,28 @@ class GenerationEngine:
 
         plan = self._tp_plan()
         arrays, specs = [], []
-        for t in self._state:
+        for i, t in enumerate(self._state):
             transform, spec = plan.get(id(t), (None, P()))
             a = t._array
+            if id(t) in self._wq_plan:
+                # quantize on the ORIGINAL layout (per-output-channel
+                # scales), then ship codes + scale through the same
+                # head-grouping/sharding as the fp weight would take
+                from paddle_tpu.quantization import quantize_absmax
+
+                q, s = quantize_absmax(a, axis=1)
+                s_tf, s_spec = self._wq_plan[id(t)]
+                if transform is not None:
+                    q = transform(q)
+                if s_tf is not None:
+                    s = s_tf(s)
+                arrays.append((
+                    jax.device_put(q, NamedSharding(self.mesh, spec)),
+                    jax.device_put(s, NamedSharding(self.mesh,
+                                                    s_spec))))
+                specs.append((spec, s_spec))
+                self._qmeta[i] = a.dtype
+                continue
             if transform is not None:
                 a = transform(a)
             arrays.append(
@@ -695,12 +889,11 @@ class GenerationEngine:
         return arrays, specs
 
     def refresh_weights(self):
-        """Re-snapshot the (tensor-parallel) serving state from the
-        live model parameters — call after a weight update. mp=1
-        engines read the live tensors every step and never need
-        this."""
-        if self._mp_axis is not None:
-            self._tp_arrays, self._tp_specs = self._build_tp_state()
+        """Re-snapshot the (tensor-parallel and/or int8-quantized)
+        serving state from the live model parameters — call after a
+        weight update. Plain fp mp=1 engines read the live tensors
+        every step and never need this."""
+        self.quantize_weights()
 
     def _step_out_shardings(self, n_repl):
         """Explicit out_shardings for a compiled step's jit: `n_repl`
@@ -720,7 +913,10 @@ class GenerationEngine:
 
         pool = NamedSharding(self.mesh, self.cache.pool_pspec())
         repl = NamedSharding(self.mesh, P())
-        return (repl,) * n_repl + (pool, pool)
+        # int8 KV: the per-block scale array trails the pools in every
+        # step's outputs, replicated (the steps pmax-fold it exact)
+        tail = (repl,) if self.kv_dtype == "int8" else ()
+        return (repl,) * n_repl + (pool, pool) + tail
 
     def _shard_steps(self, fn, n_repl):
         """Wrap a compiled-step body in shard_map over the serving
@@ -734,11 +930,14 @@ class GenerationEngine:
         from jax.sharding import PartitionSpec as P
 
         pool = self.cache.pool_pspec()
+        # int8 KV: the replicated scale array rides between the pools
+        # and the host args (inputs) and trails the pools (outputs)
+        scales = (P(),) if self.kv_dtype == "int8" else ()
         sharded = shard_map(
             fn, mesh=self.mesh,
-            in_specs=(list(self._tp_specs), pool, pool)
+            in_specs=(list(self._tp_specs), pool, pool) + scales
             + (P(),) * n_repl,
-            out_specs=(P(), pool, pool),
+            out_specs=(P(), pool, pool) + scales,
             # all-gathered logits/argmax are replicated by
             # construction; the static rep-checker can't prove it
             check_rep=False)
@@ -789,11 +988,35 @@ class GenerationEngine:
             "engine_pool_used_blocks",
             "KV pool blocks in use, by engine shard.",
             labelnames=("shard",)).labels(shard=self._shard)
+        kv_name = self.kv_dtype or np.dtype(
+            self.cache.pool_spec()[1]).name
         self._m_pool_util = m.gauge(
             "engine_pool_utilization",
             "Used fraction of allocatable KV pool blocks, by engine "
-            "shard.",
-            labelnames=("shard",)).labels(shard=self._shard)
+            "shard and pool dtype (int8 = quantized KV serving).",
+            labelnames=("shard", "kv_dtype")).labels(
+                shard=self._shard, kv_dtype=kv_name)
+        self._m_pool_bytes = m.gauge(
+            "engine_pool_bytes",
+            "Total bytes of the paged KV state (both pool planes plus "
+            "the int8 per-block scale array when quantized), by shard "
+            "and pool dtype — the capacity-claim number: int8 pools "
+            "must come in at <= 0.55x their fp16/bf16 size.",
+            labelnames=("shard", "kv_dtype")).labels(
+                shard=self._shard, kv_dtype=kv_name)
+        self._m_kv_dtype = m.gauge(
+            "engine_kv_dtype_info",
+            "Paged KV cache storage dtype this engine serves with "
+            "(1 = selected).", labelnames=("kv_dtype",))
+        self._m_kv_dtype.labels(kv_dtype=kv_name).set(1)
+        w_name = self.weight_dtype or np.dtype(
+            self.model.gpt.wte.weight._array.dtype).name
+        self._m_weight_dtype = m.gauge(
+            "engine_weight_dtype_info",
+            "Served matmul-weight storage dtype (int8 = qkv/out/fc1/"
+            "fc2 ride the compiled steps quantized; 1 = selected).",
+            labelnames=("weight_dtype",))
+        self._m_weight_dtype.labels(weight_dtype=w_name).set(1)
         self._m_pool_hw = m.gauge(
             "engine_pool_used_high_water_blocks",
             "High-water mark of KV pool blocks in use, by engine "
@@ -881,6 +1104,7 @@ class GenerationEngine:
         used = self.cache.num_blocks - 1 - self.cache.num_free
         self._m_pool_used.set(used)
         self._m_pool_util.set(used / max(self.cache.num_blocks - 1, 1))
+        self._m_pool_bytes.set(self.cache.pool_nbytes())
         self._m_pool_hw.set_max(used)
         self._m_cached_blocks.set(self.cache.num_cached_blocks)
 
@@ -913,9 +1137,29 @@ class GenerationEngine:
         backend = self.attention_backend
         mp_axis = self._mp_axis
 
+        if self.kv_dtype == "int8":
+            def decode_fn(state_arrays, kpool, vpool, scales, tokens,
+                          positions, tables):
+                arrays = self._materialize_state(state_arrays)
+                with bound_state(zip(state, arrays), state):
+                    h, kp, vp, sc = model.gpt.forward_decode_paged(
+                        Tensor._wrap(tokens), Tensor._wrap(positions),
+                        Tensor._wrap(kpool), Tensor._wrap(vpool),
+                        Tensor._wrap(tables), backend=backend,
+                        mp_axis=mp_axis,
+                        kv_scales=Tensor._wrap(scales))
+                    logits = model._logits_of(h, mp_axis=mp_axis)
+                    nxt = jnp.argmax(logits._array[:, 0], axis=-1) \
+                        .astype(jnp.int32)
+                    return nxt, kp._array, vp._array, sc._array
+
+            decode_fn.__name__ = "engine_decode_step"
+            return self._shard_steps(decode_fn, n_repl=3)
+
         def decode_fn(state_arrays, kpool, vpool, tokens, positions,
                       tables):
-            with bound_state(zip(state, state_arrays), state):
+            arrays = self._materialize_state(state_arrays)
+            with bound_state(zip(state, arrays), state):
                 h, kp, vp = model.gpt.forward_decode_paged(
                     Tensor._wrap(tokens), Tensor._wrap(positions),
                     Tensor._wrap(kpool), Tensor._wrap(vpool),
@@ -938,9 +1182,29 @@ class GenerationEngine:
         backend = self.attention_backend
         mp_axis = self._mp_axis
 
+        if self.kv_dtype == "int8":
+            def verify_fn(state_arrays, kpool, vpool, scales, tokens,
+                          positions, dlens, tables):
+                arrays = self._materialize_state(state_arrays)
+                with bound_state(zip(state, arrays), state):
+                    h, kp, vp, sc = model.gpt.forward_verify_paged(
+                        Tensor._wrap(tokens), Tensor._wrap(positions),
+                        Tensor._wrap(dlens), Tensor._wrap(kpool),
+                        Tensor._wrap(vpool), Tensor._wrap(tables),
+                        backend=backend, mp_axis=mp_axis,
+                        kv_scales=Tensor._wrap(scales))
+                    logits = model._logits_of(h, mp_axis=mp_axis)
+                    nxt = jnp.argmax(logits._array, axis=-1) \
+                        .astype(jnp.int32)
+                    return nxt, kp._array, vp._array, sc._array
+
+            verify_fn.__name__ = "engine_verify_step"
+            return self._shard_steps(verify_fn, n_repl=4)
+
         def verify_fn(state_arrays, kpool, vpool, tokens, positions,
                       dlens, tables):
-            with bound_state(zip(state, state_arrays), state):
+            arrays = self._materialize_state(state_arrays)
+            with bound_state(zip(state, arrays), state):
                 h, kp, vp = model.gpt.forward_verify_paged(
                     Tensor._wrap(tokens), Tensor._wrap(positions),
                     Tensor._wrap(dlens), Tensor._wrap(kpool),
@@ -960,10 +1224,36 @@ class GenerationEngine:
         model, state = self.model, self._state
         mp_axis = self._mp_axis
 
+        if self.kv_dtype == "int8":
+            def prefill_fn(state_arrays, kpool, vpool, scales, tokens,
+                           plen, table_row):
+                arrays = self._materialize_state(state_arrays)
+                with bound_state(zip(state, arrays), state):
+                    hidden, ks, vs = model.gpt.forward_prefill(
+                        Tensor._wrap(tokens), mp_axis=mp_axis)
+                    kp, vp, sc = paged_prefill_write(
+                        Tensor._wrap(kpool), Tensor._wrap(vpool), ks,
+                        vs, Tensor._wrap(table_row),
+                        Tensor._wrap(plen),
+                        scales=Tensor._wrap(scales), mp_axis=mp_axis)
+                    sel = (jnp.arange(tokens.shape[1]) == plen - 1) \
+                        .astype(hidden._array.dtype)
+                    h_last = (hidden._array * sel[None, :, None]) \
+                        .sum(axis=1, keepdims=True)
+                    logits = model._logits_of(Tensor._wrap(h_last),
+                                              mp_axis=mp_axis)
+                    nxt = jnp.argmax(logits._array[0, 0]) \
+                        .astype(jnp.int32)
+                    return nxt, kp._array, vp._array, sc._array
+
+            prefill_fn.__name__ = "engine_prefill"
+            return self._shard_steps(prefill_fn, n_repl=3)
+
         def prefill_fn(state_arrays, kpool, vpool, tokens, plen,
                        table_row):
             # tokens [1, bucket]; plen traced -> one program per bucket
-            with bound_state(zip(state, state_arrays), state):
+            arrays = self._materialize_state(state_arrays)
+            with bound_state(zip(state, arrays), state):
                 hidden, ks, vs = model.gpt.forward_prefill(
                     Tensor._wrap(tokens), mp_axis=mp_axis)
                 kp, vp = paged_prefill_write(
@@ -988,11 +1278,36 @@ class GenerationEngine:
         C = self.prefill_chunk
         mp_axis = self._mp_axis
 
+        if self.kv_dtype == "int8":
+            def prefill_chunk_fn(state_arrays, kpool, vpool, scales,
+                                 tokens, start, plen, table_row):
+                arrays = self._materialize_state(state_arrays)
+                with bound_state(zip(state, arrays), state):
+                    hidden, kp, vp, sc = model.gpt.forward_prefill_chunk(
+                        Tensor._wrap(tokens), Tensor._wrap(start),
+                        Tensor._wrap(kpool), Tensor._wrap(vpool),
+                        Tensor._wrap(table_row), Tensor._wrap(plen),
+                        mp_axis=mp_axis,
+                        kv_scales=Tensor._wrap(scales))
+                    sel = (start + jnp.arange(C) == plen - 1) \
+                        .astype(hidden._array.dtype)
+                    h_last = (hidden._array * sel[None, :, None]) \
+                        .sum(axis=1, keepdims=True)
+                    logits = model._logits_of(Tensor._wrap(h_last),
+                                              mp_axis=mp_axis)
+                    nxt = jnp.argmax(logits._array[0, 0]) \
+                        .astype(jnp.int32)
+                    return nxt, kp._array, vp._array, sc._array
+
+            prefill_chunk_fn.__name__ = "engine_prefill_chunk"
+            return self._shard_steps(prefill_chunk_fn, n_repl=4)
+
         def prefill_chunk_fn(state_arrays, kpool, vpool, tokens, start,
                              plen, table_row):
             # tokens [1, C] FIXED; start/plen traced -> ONE program
             # serves every chunk of every prompt length
-            with bound_state(zip(state, state_arrays), state):
+            arrays = self._materialize_state(state_arrays)
+            with bound_state(zip(state, arrays), state):
                 hidden, kp, vp = model.gpt.forward_prefill_chunk(
                     Tensor._wrap(tokens), Tensor._wrap(start),
                     Tensor._wrap(kpool), Tensor._wrap(vpool),
@@ -1101,7 +1416,25 @@ class GenerationEngine:
             # tensor parallel: the mesh-placed (weight-stationary)
             # snapshot — see refresh_weights()
             return list(self._tp_arrays)
+        if self._q_arrays is not None:
+            # int8 weights at mp=1: the quantized snapshot (weight-
+            # stationary too — refresh_weights() requantizes)
+            return list(self._q_arrays)
         return [t._array for t in self._state]
+
+    def _dispatch_step(self, jitted, *host_args):
+        """Invoke a compiled step: state + pools (+ the int8 scale
+        array) threaded in, updated pools (+ scales) re-seated on the
+        cache, the leading token output returned."""
+        c = self.cache
+        if c.scales is not None:
+            nxt, c.kpool, c.vpool, c.scales = jitted(
+                self._state_arrays(), c.kpool, c.vpool, c.scales,
+                *host_args)
+        else:
+            nxt, c.kpool, c.vpool = jitted(
+                self._state_arrays(), c.kpool, c.vpool, *host_args)
+        return nxt
 
     def _in_flight(self):
         """Ids that would collide with a new request: queued, seated in
@@ -1226,12 +1559,10 @@ class GenerationEngine:
             row[:len(slot.blocks)] = slot.blocks
             with RecordEvent("engine.prefill"):
                 t0 = time.perf_counter()
-                nxt, self.cache.kpool, self.cache.vpool = \
-                    self._prefill(
-                        self._state_arrays(), self.cache.kpool,
-                        self.cache.vpool, jnp.asarray(tokens),
-                        jnp.int32(start), jnp.int32(plen),
-                        jnp.asarray(row))
+                nxt = self._dispatch_step(
+                    self._prefill, jnp.asarray(tokens),
+                    jnp.int32(start), jnp.int32(plen),
+                    jnp.asarray(row))
                 self._m_prefill_chunks.inc()
                 slot.prefill_pos = end
                 if end < plen:         # mid-prompt: no sync needed
@@ -1272,11 +1603,9 @@ class GenerationEngine:
             admitted += 1
             with RecordEvent("engine.prefill"):
                 t0 = time.perf_counter()
-                first, self.cache.kpool, self.cache.vpool = \
-                    self._prefill(
-                        self._state_arrays(), self.cache.kpool,
-                        self.cache.vpool, jnp.asarray(tokens),
-                        jnp.int32(plen), jnp.asarray(row))
+                first = self._dispatch_step(
+                    self._prefill, jnp.asarray(tokens),
+                    jnp.int32(plen), jnp.asarray(row))
                 first = int(first)         # sync: first token is out
             self._first_token(slot, first, t0)
         self._m_queue.set(self.num_pending)
@@ -1297,9 +1626,19 @@ class GenerationEngine:
             return False
         src, dst = slot.blocks[bi], got[0]
         with RecordEvent("engine.cow"):
-            self.cache.kpool, self.cache.vpool = self._cow(
-                self.cache.kpool, self.cache.vpool,
-                jnp.int32(src), jnp.int32(dst))
+            if self.cache.scales is not None:
+                # quantized pools: the block's per-layer grid rows
+                # ride the copy — a COW'd block must dequantize on
+                # the SAME grid its source was written with
+                self.cache.kpool, self.cache.vpool, \
+                    self.cache.scales = self._cow(
+                        self.cache.kpool, self.cache.vpool,
+                        jnp.int32(src), jnp.int32(dst),
+                        self.cache.scales)
+            else:
+                self.cache.kpool, self.cache.vpool = self._cow(
+                    self.cache.kpool, self.cache.vpool,
+                    jnp.int32(src), jnp.int32(dst))
         self.cache.free([src])         # drop our shared reference
         slot.blocks[bi] = dst
         self._m_cow.inc()
@@ -1348,9 +1687,8 @@ class GenerationEngine:
             tables[i, :len(slot.blocks)] = slot.blocks
         with RecordEvent("engine.decode"):
             t_dec = time.perf_counter()
-            nxt, self.cache.kpool, self.cache.vpool = self._decode(
-                self._state_arrays(), self.cache.kpool,
-                self.cache.vpool, jnp.asarray(tokens),
+            nxt = self._dispatch_step(
+                self._decode, jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(tables))
             nxt = np.asarray(nxt)      # sync: tokens are out
             self._m_decode_seconds.observe(
@@ -1501,9 +1839,8 @@ class GenerationEngine:
             tables[i, :len(slot.blocks)] = slot.blocks
         with RecordEvent("engine.decode"):
             t_dec = time.perf_counter()
-            nxt, self.cache.kpool, self.cache.vpool = self._decode(
-                self._state_arrays(), self.cache.kpool,
-                self.cache.vpool, jnp.asarray(tokens),
+            nxt = self._dispatch_step(
+                self._decode, jnp.asarray(tokens),
                 jnp.asarray(positions), jnp.asarray(dlens),
                 jnp.asarray(tables))
             nxt = np.asarray(nxt)      # sync: [slots, K+1] argmaxes

@@ -38,13 +38,19 @@ def _mp_degree():
 # (tpu-verify TPU104; declared here because the helpers right below
 # are the only places serving collectives come from). Per transformer
 # layer: _attn_out all-gathers twice (head reassembly + out_proj
-# columns) and the MLP twice (fc1 + fc2 columns) = 4; fixed: one
-# lm-head logits all-gather + one vocab-parallel-embedding psum. An
-# accidental fifth per-layer gather (or a brand-new collective kind)
-# fails the trace gate instead of stretching every decode step.
+# columns) and the MLP twice (fc1 + fc2 columns) = 4, plus AT MOST one
+# pmax when the int8 KV cache is on (the quant-on-write grid fold in
+# ops/paged_attention — per-block scales are global across the
+# head-sharded pools, so the shards' absmax must agree; fp steps emit
+# zero pmax and TPU100's exact op snapshot pins that). Fixed: one
+# lm-head logits all-gather + one vocab-parallel-embedding psum, plus
+# one pmax for the bucketed prefill's whole-prompt quantized write
+# (all layers folded in a single scatter). An accidental fifth
+# per-layer gather (or a brand-new collective kind) fails the trace
+# gate instead of stretching every decode step.
 GPT_SERVING_COLLECTIVES = CollectiveBudget(
-    per_layer=(("all_gather", 4),),
-    fixed=(("all_gather", 1), ("psum", 1)),
+    per_layer=(("all_gather", 4), ("pmax", 1)),
+    fixed=(("all_gather", 1), ("psum", 1), ("pmax", 1)),
 )
 
 
@@ -223,17 +229,26 @@ class GPTAttention(nn.Layer):
         return self._attn_out(out, B, S, mp_axis), k, v
 
     def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
-                              block_row, start, plen, mp_axis=None):
+                              block_row, start, plen, mp_axis=None,
+                              kv_scales=None):
         """Chunked prefill for ONE slot against the paged pool: write
         this chunk's k/v through the slot's block table and attend the
         chunk's queries over the whole context so far (shared prefix
         blocks included, read-only). x [1,C,H]; start/plen traced
         scalars — one compiled program per chunk WIDTH, not per prompt
-        length. Returns (out [1,C,H], new_kpool, new_vpool)."""
+        length. Returns (out [1,C,H], new_kpool, new_vpool), plus the
+        updated per-block scale array when `kv_scales` rides along
+        (int8 KV serving)."""
         from paddle_tpu.ops.paged_attention import paged_prefill_chunk
 
         B, C, H = x.shape  # B == 1
         q, k, v = self._qkv_heads(x, mp_axis)
+        if kv_scales is not None:
+            out, kpool, vpool, kv_scales = paged_prefill_chunk(
+                q, k, v, kpool, vpool, layer_idx, block_row, start,
+                plen, scales=kv_scales, mp_axis=mp_axis)
+            return (self._attn_out(out, B, C, mp_axis), kpool, vpool,
+                    kv_scales)
         out, kpool, vpool = paged_prefill_chunk(
             q, k, v, kpool, vpool, layer_idx, block_row, start, plen)
         return self._attn_out(out, B, C, mp_axis), kpool, vpool
@@ -273,7 +288,7 @@ class GPTAttention(nn.Layer):
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, backend="auto",
-                             mp_axis=None):
+                             mp_axis=None, kv_scales=None):
         """Batched one-token decode against the GLOBAL paged KV pool
         (the continuous-batching engine's layer step). x [slots,1,H];
         kpool/vpool [layers, num_blocks, block_size, heads, D];
@@ -283,11 +298,20 @@ class GPTAttention(nn.Layer):
         With `mp_axis` set (inside the engine's shard_map step) the
         pools and q/k/v carry heads/mp heads; the attention op is
         head-count agnostic, so both backends run per-shard unchanged.
-        Returns (out, new_kpool, new_vpool)."""
+        With `kv_scales` (int8 KV serving) the pools are int8 and the
+        updated `[L, blocks, 2]` scale array returns as a 4th output.
+        Returns (out, new_kpool, new_vpool[, new_kv_scales])."""
         from paddle_tpu.ops.paged_attention import paged_attention_step
 
         B, S, H = x.shape  # S == 1
         q, k, v = self._qkv_heads(x, mp_axis)
+        if kv_scales is not None:
+            out, kpool, vpool, kv_scales = paged_attention_step(
+                q, k, v, kpool, vpool, layer_idx, block_tables,
+                positions, backend=backend, scales=kv_scales,
+                mp_axis=mp_axis)
+            return (self._attn_out(out, B, 1, mp_axis), kpool, vpool,
+                    kv_scales)
         out, kpool, vpool = paged_attention_step(
             q, k, v, kpool, vpool, layer_idx, block_tables, positions,
             backend=backend)
@@ -295,7 +319,8 @@ class GPTAttention(nn.Layer):
 
     def forward_verify_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, draft_lens,
-                             backend="auto", mp_axis=None):
+                             backend="auto", mp_axis=None,
+                             kv_scales=None):
         """Speculative K-token verify over the GLOBAL paged pool: one
         fixed `[slots, W]` window per lane (W = K+1: the feed token
         plus the drafts). x [slots,W,H]; positions [slots] absolute
@@ -304,11 +329,19 @@ class GPTAttention(nn.Layer):
         every live row's k/v through the table and attends each window
         query causally up to its own position — the target model
         scores all W candidate positions in one pass. Returns
-        (out [slots,W,H], new_kpool, new_vpool)."""
+        (out [slots,W,H], new_kpool, new_vpool), plus the updated
+        scale array under int8 KV serving (`kv_scales`)."""
         from paddle_tpu.ops.paged_attention import paged_verify_window
 
         B, W, H = x.shape
         q, k, v = self._qkv_heads(x, mp_axis)
+        if kv_scales is not None:
+            out, kpool, vpool, kv_scales = paged_verify_window(
+                q, k, v, kpool, vpool, layer_idx, block_tables,
+                positions, draft_lens, backend=backend,
+                scales=kv_scales, mp_axis=mp_axis)
+            return (self._attn_out(out, B, W, mp_axis), kpool, vpool,
+                    kv_scales)
         out, kpool, vpool = paged_verify_window(
             q, k, v, kpool, vpool, layer_idx, block_tables, positions,
             draft_lens, backend=backend)
@@ -380,7 +413,15 @@ class GPTBlock(nn.Layer):
         return x + self.mlp(self.ln2(x), mp_axis=mp_axis), k, v
 
     def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
-                              block_row, start, plen, mp_axis=None):
+                              block_row, start, plen, mp_axis=None,
+                              kv_scales=None):
+        if kv_scales is not None:
+            a, kpool, vpool, kv_scales = self.attn.forward_prefill_chunk(
+                self.ln1(x), kpool, vpool, layer_idx, block_row,
+                start, plen, mp_axis=mp_axis, kv_scales=kv_scales)
+            x = x + a
+            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+                    vpool, kv_scales)
         a, kpool, vpool = self.attn.forward_prefill_chunk(
             self.ln1(x), kpool, vpool, layer_idx, block_row, start,
             plen, mp_axis=mp_axis)
@@ -397,7 +438,15 @@ class GPTBlock(nn.Layer):
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, backend="auto",
-                             mp_axis=None):
+                             mp_axis=None, kv_scales=None):
+        if kv_scales is not None:
+            a, kpool, vpool, kv_scales = self.attn.forward_decode_paged(
+                self.ln1(x), kpool, vpool, layer_idx, block_tables,
+                positions, backend=backend, mp_axis=mp_axis,
+                kv_scales=kv_scales)
+            x = x + a
+            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+                    vpool, kv_scales)
         a, kpool, vpool = self.attn.forward_decode_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
             positions, backend=backend, mp_axis=mp_axis)
@@ -407,7 +456,16 @@ class GPTBlock(nn.Layer):
 
     def forward_verify_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, draft_lens,
-                             backend="auto", mp_axis=None):
+                             backend="auto", mp_axis=None,
+                             kv_scales=None):
+        if kv_scales is not None:
+            a, kpool, vpool, kv_scales = self.attn.forward_verify_paged(
+                self.ln1(x), kpool, vpool, layer_idx, block_tables,
+                positions, draft_lens, backend=backend,
+                mp_axis=mp_axis, kv_scales=kv_scales)
+            x = x + a
+            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+                    vpool, kv_scales)
         a, kpool, vpool = self.attn.forward_verify_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
             positions, draft_lens, backend=backend, mp_axis=mp_axis)
@@ -466,7 +524,8 @@ class GPTModel(nn.Layer):
         return self.ln_f(h), mp.stack(ks, axis=0), mp.stack(vs, axis=0)
 
     def forward_prefill_chunk(self, token_ids, start, kpool, vpool,
-                              block_row, plen, mp_axis=None):
+                              block_row, plen, mp_axis=None,
+                              kv_scales=None):
         """Chunked paged prefill (the engine's incremental admission
         path): token_ids [1,C] — chunk `[start, start+C)` of one
         slot's prompt, padded past `plen`; kpool/vpool the global
@@ -486,6 +545,12 @@ class GPTModel(nn.Layer):
                               0, self.config.max_seq_len - 1)
         h = self._embed(token_ids, mp_axis) \
             + self.wpe(pos_vec).unsqueeze(0)
+        if kv_scales is not None:
+            for i, blk in enumerate(self.blocks):
+                h, kpool, vpool, kv_scales = blk.forward_prefill_chunk(
+                    h, kpool, vpool, i, block_row, pos_t, plen,
+                    mp_axis=mp_axis, kv_scales=kv_scales)
+            return self.ln_f(h), kpool, vpool, kv_scales
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_prefill_chunk(
                 h, kpool, vpool, i, block_row, pos_t, plen,
@@ -514,7 +579,7 @@ class GPTModel(nn.Layer):
 
     def forward_decode_paged(self, token_ids, positions, kpool, vpool,
                              block_tables, backend="auto",
-                             mp_axis=None):
+                             mp_axis=None, kv_scales=None):
         """Batched decode step over the paged pool (continuous-batching
         engine path): token_ids [slots,1], positions [slots] int32
         per-slot absolute positions, kpool/vpool
@@ -529,6 +594,13 @@ class GPTModel(nn.Layer):
             else paddle.to_tensor(positions, dtype="int32")
         h = self._embed(token_ids, mp_axis) \
             + self.wpe(pos_t).unsqueeze(1)
+        if kv_scales is not None:
+            for i, blk in enumerate(self.blocks):
+                h, kpool, vpool, kv_scales = blk.forward_decode_paged(
+                    h, kpool, vpool, i, block_tables, pos_t,
+                    backend=backend, mp_axis=mp_axis,
+                    kv_scales=kv_scales)
+            return self.ln_f(h), kpool, vpool, kv_scales
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_decode_paged(
                 h, kpool, vpool, i, block_tables, pos_t,
@@ -537,7 +609,8 @@ class GPTModel(nn.Layer):
 
     def forward_verify_paged(self, token_ids, positions, draft_lens,
                              kpool, vpool, block_tables,
-                             backend="auto", mp_axis=None):
+                             backend="auto", mp_axis=None,
+                             kv_scales=None):
         """Speculative verify step over the paged pool (the engine's
         K-token decode): token_ids [slots, W] — the feed token plus up
         to W-1 drafted tokens per lane, positions [slots] int32 row-0
@@ -564,6 +637,13 @@ class GPTModel(nn.Layer):
             + paddle.arange(W, dtype="int32").unsqueeze(0),
             0, self.config.max_seq_len - 1)            # [B, W]
         h = self._embed(token_ids, mp_axis) + self.wpe(wpos)
+        if kv_scales is not None:
+            for i, blk in enumerate(self.blocks):
+                h, kpool, vpool, kv_scales = blk.forward_verify_paged(
+                    h, kpool, vpool, i, block_tables, pos_t, dlen_t,
+                    backend=backend, mp_axis=mp_axis,
+                    kv_scales=kv_scales)
+            return self.ln_f(h), kpool, vpool, kv_scales
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_verify_paged(
                 h, kpool, vpool, i, block_tables, pos_t, dlen_t,

@@ -95,9 +95,17 @@ from .dispatch import apply, as_tensor
 __all__ = ["paged_attention_step", "paged_verify_window",
            "paged_prefill_write", "paged_prefill_chunk",
            "copy_pool_block", "dense_gather_reference",
-           "resolve_backend", "PAGED_BACKENDS", "PAGED_PATH_STATS"]
+           "resolve_backend", "PAGED_BACKENDS", "PAGED_PATH_STATS",
+           "KV_QUANT_EPS"]
 
 PAGED_BACKENDS = ("auto", "dense", "pallas")
+
+#: Scale floor of the int8 per-block-quantized KV cache. Freshly
+#: allocated blocks have their scale rows reset here (PagedKVCache
+#: .allocate), so a stale previous owner's scale can never poison a
+#: new tenant's quantization grid; a first write whose absmax is below
+#: 127*EPS quantizes against the floor (absolute error <= ~1e-6).
+KV_QUANT_EPS = 1e-8
 
 # which backend paged_attention_step dispatched to, incremented per
 # call (so per TRACE under jit — the engine's compiled decode bumps it
@@ -146,8 +154,18 @@ def resolve_backend(backend, head_dim, block_size):
 
 
 def paged_attention_step(q, k, v, kpool, vpool, layer, block_tables,
-                         positions, scale=None, backend="auto"):
+                         positions, scale=None, backend="auto",
+                         scales=None, mp_axis=None):
     """One batched decode step against the paged cache, for one layer.
+
+    With `scales` (the int8 engine's `[layers, num_blocks, 2]`
+    per-block K/V scale array) the pools are int8: the step
+    quantizes-on-write (growing + requantizing the written blocks'
+    grids), dequantizes the streamed blocks inside the matmuls, and
+    returns a FOUR-tuple `(out, new_kpool, new_vpool, new_scales)`.
+    `mp_axis` names the mesh axis whose shards must agree on the
+    per-block grid (one lax.pmax per layer); None off-mesh. Without
+    `scales` the fp path below is bit-identical to pre-int8 behavior.
 
     q/k/v: `[slots, 1, heads, head_dim]` — this step's projections.
     kpool/vpool: `[layers, num_blocks, block_size, heads, head_dim]`.
@@ -172,6 +190,28 @@ def paged_attention_step(q, k, v, kpool, vpool, layer, block_tables,
     resolved = resolve_backend(backend, head_dim=q.shape[3],
                                block_size=kpool.shape[2])
     PAGED_PATH_STATS[resolved] += 1
+    if scales is not None:
+        scales = as_tensor(scales)
+        if resolved == "pallas":
+            from .pallas.paged_attention import paged_decode_attention
+
+            interpret = not _on_tpu()
+
+            def fn(qa, ka, va, kp, vp, sc, bt, pos):
+                kp, vp, sc, kq, vq = _quant_write_decode(
+                    kp, vp, sc, ka, va, bt, pos, layer, mp_axis)
+                out, kp, vp = paged_decode_attention(
+                    qa, kq[:, None], vq[:, None], kp, vp, layer, bt,
+                    pos, scale=scale, interpret=interpret,
+                    kv_scales=sc[layer])
+                return out, kp, vp, sc
+        else:
+            def fn(qa, ka, va, kp, vp, sc, bt, pos):
+                return _dense_step_q(qa, ka, va, kp, vp, sc, layer,
+                                     bt, pos, scale, mp_axis)
+
+        return apply("paged_attention_step", fn, q, k, v, kpool,
+                     vpool, scales, block_tables, positions)
     if resolved == "pallas":
         from .pallas.paged_attention import paged_decode_attention
 
@@ -240,11 +280,232 @@ def _dense_step(qa, ka, va, kp, vp, layer, bt, pos, scale):
     return out[:, None], kp, vp
 
 
+# ---------------------------------------------------------------------------
+# int8 per-block-scaled KV quantization (PR 11)
+#
+# Layout: int8 pools + ONE f32 scale array `[layers, num_blocks, 2]`
+# (column 0 = K scale, column 1 = V scale) riding the compiled steps
+# alongside the pools. Policy, shared verbatim by every write path so
+# cold/warm/chunked/bucketed runs quantize byte-identically:
+#
+# - symmetric absmax, clip to +/-127 (-128 unused);
+# - per-block scales are MONOTONE: a write whose row absmax exceeds
+#   the block's current grid grows the scale and REQUANTIZES the
+#   written block's existing rows (round(q * s_old/s_new) — factor
+#   <= 1, so no clipping) before the new rows land. Only the written
+#   (engine-guaranteed private) blocks are touched, so shared /
+#   prefix-cached blocks and their scales are never mutated by a
+#   borrower — the COW/prefix sharing story is unchanged;
+# - under tensor parallel the pools are head-sharded but the scales
+#   are per-(layer, block) GLOBAL: one lax.pmax over the mp axis per
+#   layer write folds the shards' absmax, so mp=N quantizes on the
+#   same grid as mp=1 (token-identical int8 serving across mesh
+#   shapes; the budget lives in GPT_SERVING_COLLECTIVES);
+# - dequant is fused into the streamed-block matmuls: logits and PV
+#   are computed over the int8 values cast to f32 and scaled ONCE per
+#   block (linearity: q . (K*s) == (q . K) * s), fp32 online softmax
+#   unchanged. Both backends use the identical operation order so the
+#   dense fallback and the Pallas kernel agree token-for-token.
+# ---------------------------------------------------------------------------
+
+def _requant_grow(blk, factor):
+    """Rescale a written block's existing int8 rows onto a grown grid:
+    factor = s_old/s_new <= 1, so round() never needs a clip."""
+    return jnp.round(blk.astype(jnp.float32) * factor).astype(jnp.int8)
+
+
+def _quant_rows(rows, s):
+    """Quantize fp rows onto the block grid `s` (broadcast f32)."""
+    return jnp.clip(jnp.round(rows.astype(jnp.float32) / s),
+                    -127, 127).astype(jnp.int8)
+
+
+def _fold_amax(amax, mp_axis):
+    """Per-block scale candidates must cover ALL heads; under a
+    head-sharded mesh each shard sees only its own, so fold with one
+    cross-shard max (exact — max is associative/commutative)."""
+    if mp_axis is None:
+        return amax
+    return jax.lax.pmax(amax, mp_axis)
+
+
+def _quant_write_decode(kp, vp, sc, ka, va, bt, pos, layer, mp_axis):
+    """Quant-on-write bookkeeping for one decode row per slot: grow +
+    requantize each slot's write block, update its scale row, and
+    return the QUANTIZED new rows (not yet written — each backend
+    lands them its own way: the dense path scatters, the Pallas
+    kernel DMAs). Returns (kp, vp, sc, kq [B,heads,D], vq)."""
+    bs = kp.shape[2]
+    bid_w = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
+    ak = jnp.max(jnp.abs(ka[:, 0].astype(jnp.float32)), axis=(1, 2))
+    av = jnp.max(jnp.abs(va[:, 0].astype(jnp.float32)), axis=(1, 2))
+    amax = _fold_amax(jnp.stack([ak, av], axis=-1) / 127.0, mp_axis)
+    s_old = sc[layer, bid_w]                             # [B, 2]
+    s_new = jnp.maximum(jnp.maximum(s_old, amax), KV_QUANT_EPS)
+    fac = s_old / s_new
+    kp = kp.at[layer, bid_w].set(
+        _requant_grow(kp[layer, bid_w], fac[:, 0][:, None, None, None]))
+    vp = vp.at[layer, bid_w].set(
+        _requant_grow(vp[layer, bid_w], fac[:, 1][:, None, None, None]))
+    sc = sc.at[layer, bid_w].set(s_new)
+    kq = _quant_rows(ka[:, 0], s_new[:, 0][:, None, None])
+    vq = _quant_rows(va[:, 0], s_new[:, 1][:, None, None])
+    return kp, vp, sc, kq, vq
+
+
+def _quant_write_window(kp, vp, sc, ka, va, bt, pos, dlen, layer,
+                        mp_axis):
+    """Window edition of `_quant_write_decode`: W contiguous write
+    positions per slot (the speculative verify window). The window
+    spans a STATIC number of candidate table slots, so the grow +
+    requantize pass gathers just those blocks. Dead rows (i > dlen)
+    are excluded from the absmax and quantize to garbage the engine
+    never reads. Returns (kp, vp, sc, kq [B,W,heads,D], vq)."""
+    B, W = ka.shape[0], ka.shape[1]
+    bs = kp.shape[2]
+    maxb = bt.shape[1]
+    nb = (W - 1) // bs + 2                 # static candidate count
+    wpos = pos[:, None] + jnp.arange(W)[None, :]         # [B, W]
+    live = jnp.arange(W)[None, :] <= dlen[:, None]       # [B, W]
+    first = pos // bs                                    # [B]
+    seg = jnp.clip(wpos // bs - first[:, None], 0, nb - 1)
+    # candidates past the table route to the NULL block — a clamped
+    # index must never scatter-race the real last block's grid
+    cand = first[:, None] + jnp.arange(nb)[None, :]      # [B, nb]
+    ti = jnp.minimum(cand, maxb - 1)
+    bids = jnp.where(cand <= maxb - 1,
+                     jnp.take_along_axis(bt, ti, axis=1), 0)
+    rk = jnp.max(jnp.abs(ka.astype(jnp.float32)), axis=(2, 3))
+    rv = jnp.max(jnp.abs(va.astype(jnp.float32)), axis=(2, 3))
+    zero = jnp.zeros((B, nb), jnp.float32)
+    need_k = zero.at[jnp.arange(B)[:, None], seg].max(
+        jnp.where(live, rk, 0.0))
+    need_v = zero.at[jnp.arange(B)[:, None], seg].max(
+        jnp.where(live, rv, 0.0))
+    amax = _fold_amax(jnp.stack([need_k, need_v], axis=-1) / 127.0,
+                      mp_axis)                           # [B, nb, 2]
+    s_old = sc[layer, bids]                              # [B, nb, 2]
+    s_new = jnp.maximum(jnp.maximum(s_old, amax), KV_QUANT_EPS)
+    fac = s_old / s_new
+    kp = kp.at[layer, bids].set(
+        _requant_grow(kp[layer, bids],
+                      fac[..., 0][..., None, None, None]))
+    vp = vp.at[layer, bids].set(
+        _requant_grow(vp[layer, bids],
+                      fac[..., 1][..., None, None, None]))
+    sc = sc.at[layer, bids].set(s_new)
+    s_row = jnp.take_along_axis(s_new, seg[..., None], axis=1)  # [B,W,2]
+    kq = _quant_rows(ka, s_row[..., 0][..., None, None])
+    vq = _quant_rows(va, s_row[..., 1][..., None, None])
+    return kp, vp, sc, kq, vq
+
+
+def _dense_step_q(qa, ka, va, kp, vp, sc, layer, bt, pos, scale,
+                  mp_axis):
+    """int8 edition of `_dense_step`: quant-on-write, then the SAME
+    fori_loop online softmax with dequant fused into the per-block
+    matmuls (one scale multiply per streamed block; fp32 logits,
+    softmax state and PV accumulation unchanged)."""
+    B = qa.shape[0]
+    heads, d = qa.shape[2], qa.shape[3]
+    bs = kp.shape[2]
+    kp, vp, sc, kq, vq = _quant_write_decode(kp, vp, sc, ka, va, bt,
+                                             pos, layer, mp_axis)
+    bid_w = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs
+    kp = kp.at[layer, bid_w, off].set(kq)
+    vp = vp.at[layer, bid_w, off].set(vq)
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    qf = qa[:, 0].astype(jnp.float32)              # [B, heads, d]
+    hw_blocks = jnp.max(pos) // bs + 1             # traced scalar
+
+    def body(j, carry):
+        m, l, acc = carry
+        bid = jax.lax.dynamic_index_in_dim(bt, j, axis=1,
+                                           keepdims=False)   # [B]
+        keys = kp[layer, bid].astype(jnp.float32)  # [B, bs, heads, d]
+        vals = vp[layer, bid].astype(jnp.float32)
+        ks, vs = sc[layer, bid, 0], sc[layer, bid, 1]        # [B]
+        logits = jnp.einsum("bhd,bkhd->bhk", qf, keys,
+                            preferred_element_type=jnp.float32) * s
+        logits = logits * ks[:, None, None]        # fused dequant (K)
+        allowed = (j * bs + jnp.arange(bs))[None, :] <= pos[:, None]
+        logits = jnp.where(allowed[:, None, :], logits, -1e30)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)                # [B, heads, bs] f32
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhk,bkhd->bhd", p, vals,
+                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv * vs[:, None, None]
+
+    m0 = jnp.full((B, heads, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, heads, 1), jnp.float32)
+    acc0 = jnp.zeros((B, heads, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, hw_blocks, body, (m0, l0, acc0))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)  # cast ONCE
+    return out[:, None], kp, vp, sc
+
+
+def _dense_verify_q(qa, ka, va, kp, vp, sc, layer, bt, pos, dlen,
+                    scale, mp_axis):
+    """int8 edition of `_dense_verify`: window quant-on-write, then
+    the W-query online softmax with per-block fused dequant."""
+    B, W = qa.shape[0], qa.shape[1]
+    heads, d = qa.shape[2], qa.shape[3]
+    bs = kp.shape[2]
+    maxb = bt.shape[1]
+    kp, vp, sc, kq, vq = _quant_write_window(kp, vp, sc, ka, va, bt,
+                                             pos, dlen, layer, mp_axis)
+    wpos = pos[:, None] + jnp.arange(W)[None, :]       # [B, W] absolute
+    live = jnp.arange(W)[None, :] <= dlen[:, None]     # [B, W]
+    bid = jnp.where(
+        live, jnp.take_along_axis(bt, jnp.minimum(wpos // bs, maxb - 1),
+                                  axis=1), 0)
+    off = wpos % bs
+    kp = kp.at[layer, bid, off].set(kq)                # [B, W, heads, d]
+    vp = vp.at[layer, bid, off].set(vq)
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    qf = qa.astype(jnp.float32)                        # [B, W, heads, d]
+    hw_blocks = jnp.max(pos + dlen) // bs + 1          # traced scalar
+
+    def body(j, carry):
+        m, l, acc = carry
+        bidj = jax.lax.dynamic_index_in_dim(bt, j, axis=1,
+                                            keepdims=False)    # [B]
+        keys = kp[layer, bidj].astype(jnp.float32)  # [B, bs, heads, d]
+        vals = vp[layer, bidj].astype(jnp.float32)
+        ks, vs = sc[layer, bidj, 0], sc[layer, bidj, 1]        # [B]
+        logits = jnp.einsum("bwhd,bkhd->bhwk", qf, keys,
+                            preferred_element_type=jnp.float32) * s
+        logits = logits * ks[:, None, None, None]   # fused dequant (K)
+        allowed = (j * bs + jnp.arange(bs))[None, None, :] \
+            <= wpos[:, :, None]                  # [B, W, bs]
+        logits = jnp.where(allowed[:, None, :, :], logits, -1e30)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)              # [B, heads, W, bs] f32
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhwk,bkhd->bhwd", p, vals,
+                        preferred_element_type=jnp.float32)
+        return (m_new, l_new,
+                acc * alpha + pv * vs[:, None, None, None])
+
+    m0 = jnp.full((B, heads, W, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, heads, W, 1), jnp.float32)
+    acc0 = jnp.zeros((B, heads, W, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, hw_blocks, body, (m0, l0, acc0))
+    out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)  # cast ONCE
+    return out.transpose(0, 2, 1, 3), kp, vp, sc   # [B, W, heads, d]
+
+
 def paged_verify_window(q, k, v, kpool, vpool, layer, block_tables,
                         positions, draft_lens, scale=None,
-                        backend="auto"):
+                        backend="auto", scales=None, mp_axis=None):
     """Speculative-verify attention over a fixed `[slots, W]` token
-    window (W = K+1), for one layer.
+    window (W = K+1), for one layer. With `scales` the int8
+    quantized-KV contract of `paged_attention_step` applies (window
+    edition) and a four-tuple `(out, kpool, vpool, scales)` returns.
 
     q/k/v: `[slots, W, heads, head_dim]` — the window's projections
     (feed token at row 0, drafted tokens after it).
@@ -272,6 +533,29 @@ def paged_verify_window(q, k, v, kpool, vpool, layer, block_tables,
     resolved = resolve_backend(backend, head_dim=q.shape[3],
                                block_size=kpool.shape[2])
     PAGED_PATH_STATS[resolved] += 1
+    if scales is not None:
+        scales = as_tensor(scales)
+        if resolved == "pallas":
+            from .pallas.paged_attention import paged_verify_attention
+
+            interpret = not _on_tpu()
+
+            def fn(qa, ka, va, kp, vp, sc, bt, pos, dlen):
+                kp, vp, sc, kq, vq = _quant_write_window(
+                    kp, vp, sc, ka, va, bt, pos, dlen, layer, mp_axis)
+                out, kp, vp = paged_verify_attention(
+                    qa, kq, vq, kp, vp, layer, bt, pos, dlen,
+                    scale=scale, interpret=interpret,
+                    kv_scales=sc[layer])
+                return out, kp, vp, sc
+        else:
+            def fn(qa, ka, va, kp, vp, sc, bt, pos, dlen):
+                return _dense_verify_q(qa, ka, va, kp, vp, sc, layer,
+                                       bt, pos, dlen, scale, mp_axis)
+
+        return apply("paged_verify_window", fn, q, k, v, kpool,
+                     vpool, scales, block_tables, positions,
+                     draft_lens)
     if resolved == "pallas":
         from .pallas.paged_attention import paged_verify_attention
 
@@ -346,8 +630,18 @@ def _dense_verify(qa, ka, va, kp, vp, layer, bt, pos, dlen, scale):
     return out.transpose(0, 2, 1, 3), kp, vp       # [B, W, heads, d]
 
 
-def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):
+def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen,
+                        scales=None, mp_axis=None):
     """Scatter a prefilled prompt's per-layer k/v into the pools.
+
+    With `scales` (int8 pools) each written block's grid is computed
+    from the rows landing in it this call. The bucketed path always
+    writes into FRESHLY allocated blocks (scale rows reset to
+    KV_QUANT_EPS by the allocator), so the grid only ever grows from
+    the floor via an order-independent scatter-max and the stale int8
+    bytes beyond `plen` — unreachable through position-bounded
+    attention — need no requantization. Returns
+    `(kpool, vpool, scales)`.
 
     kstack/vstack: `[layers, 1, S, heads, head_dim]` from
     `GPTModel.forward_prefill` over the (bucket-padded) prompt.
@@ -362,6 +656,43 @@ def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):
     kpool, vpool = as_tensor(kpool), as_tensor(vpool)
     kstack, vstack = as_tensor(kstack), as_tensor(vstack)
     block_row, plen = as_tensor(block_row), as_tensor(plen)
+
+    if scales is not None:
+        scales = as_tensor(scales)
+
+        def fnq(kp, vp, sc, ks, vs, row, n):
+            L, S = ks.shape[0], ks.shape[2]
+            bs = kp.shape[2]
+            nb = (S - 1) // bs + 1             # static: bucket blocks
+            pos = jnp.arange(S)
+            valid = pos < n
+            bid = jnp.where(valid, row[pos // bs], 0)
+            off = pos % bs
+            seg = pos // bs                    # [S] in [0, nb)
+            rk = jnp.max(jnp.abs(ks[:, 0].astype(jnp.float32)),
+                         axis=(2, 3))          # [L, S]
+            rv = jnp.max(jnp.abs(vs[:, 0].astype(jnp.float32)),
+                         axis=(2, 3))
+            zero = jnp.zeros((L, nb), jnp.float32)
+            need_k = zero.at[:, seg].max(jnp.where(valid, rk, 0.0))
+            need_v = zero.at[:, seg].max(jnp.where(valid, rv, 0.0))
+            need = _fold_amax(
+                jnp.stack([need_k, need_v], axis=-1) / 127.0, mp_axis)
+            # candidate block per segment: null 0 when the segment has
+            # no valid rows (its `need` is 0 there — a no-op max)
+            bids = jnp.where((jnp.arange(nb) * bs) < n, row[:nb], 0)
+            s_fin = jnp.maximum(
+                jnp.maximum(sc[:, bids], need), KV_QUANT_EPS)
+            sc = sc.at[:, bids].max(s_fin)     # order-independent
+            s_row = s_fin[:, seg]              # [L, S, 2]
+            kq = _quant_rows(ks[:, 0], s_row[..., 0][..., None, None])
+            vq = _quant_rows(vs[:, 0], s_row[..., 1][..., None, None])
+            kp = kp.at[:, bid, off].set(kq)    # [layers, S, heads, D]
+            vp = vp.at[:, bid, off].set(vq)
+            return kp, vp, sc
+
+        return apply("paged_prefill_write", fnq, kpool, vpool, scales,
+                     kstack, vstack, block_row, plen)
 
     def fn(kp, vp, ks, vs, row, n):
         S = ks.shape[2]
@@ -378,7 +709,7 @@ def paged_prefill_write(kpool, vpool, kstack, vstack, block_row, plen):
 
 
 def paged_prefill_chunk(q, k, v, kpool, vpool, layer, block_row, start,
-                        plen, scale=None):
+                        plen, scale=None, scales=None, mp_axis=None):
     """One chunked-prefill step for ONE slot, for one layer: write the
     chunk's k/v into the pool, then attend the chunk's queries over the
     slot's whole context so far (shared prefix blocks + earlier chunks
@@ -404,6 +735,93 @@ def paged_prefill_chunk(q, k, v, kpool, vpool, layer, block_row, start,
     kpool, vpool = as_tensor(kpool), as_tensor(vpool)
     block_row = as_tensor(block_row)
     start, plen = as_tensor(start), as_tensor(plen)
+
+    if scales is not None:
+        scales = as_tensor(scales)
+
+        def fnq(qa, ka, va, kp, vp, sc, row, s0, n):
+            C = qa.shape[1]
+            heads, d = qa.shape[2], qa.shape[3]
+            bs = kp.shape[2]
+            maxb = row.shape[0]
+            nb = (C - 1) // bs + 2         # static candidate blocks
+            pos = s0 + jnp.arange(C)                       # absolute [C]
+            valid = pos < n
+            first = s0 // bs
+            seg = jnp.clip(pos // bs - first, 0, nb - 1)   # [C]
+            # a chunk may finish a block an EARLIER chunk started, so
+            # the grid must grow + requantize (unlike the bucketed
+            # fresh-block writer). Candidates with no valid rows keep
+            # their scale (need 0) and requantize by factor 1 — exact.
+            # Candidates past the table route to the NULL block so a
+            # clamped index can never scatter-race the real last block.
+            cand = first + jnp.arange(nb)
+            ti = jnp.minimum(cand, maxb - 1)
+            bids = jnp.where(cand <= maxb - 1, row[ti], 0)  # [nb]
+            rk = jnp.max(jnp.abs(ka[0].astype(jnp.float32)),
+                         axis=(1, 2))                      # [C]
+            rv = jnp.max(jnp.abs(va[0].astype(jnp.float32)),
+                         axis=(1, 2))
+            zero = jnp.zeros(nb, jnp.float32)
+            need_k = zero.at[seg].max(jnp.where(valid, rk, 0.0))
+            need_v = zero.at[seg].max(jnp.where(valid, rv, 0.0))
+            amax = _fold_amax(
+                jnp.stack([need_k, need_v], axis=-1) / 127.0, mp_axis)
+            s_old = sc[layer, bids]                        # [nb, 2]
+            s_new = jnp.maximum(jnp.maximum(s_old, amax), KV_QUANT_EPS)
+            fac = s_old / s_new
+            kp = kp.at[layer, bids].set(
+                _requant_grow(kp[layer, bids],
+                              fac[:, 0][:, None, None, None]))
+            vp = vp.at[layer, bids].set(
+                _requant_grow(vp[layer, bids],
+                              fac[:, 1][:, None, None, None]))
+            sc = sc.at[layer, bids].set(s_new)
+            s_row = s_new[seg]                             # [C, 2]
+            kq = _quant_rows(ka[0], s_row[:, 0][:, None, None])
+            vq = _quant_rows(va[0], s_row[:, 1][:, None, None])
+            bid = jnp.where(valid,
+                            row[jnp.minimum(pos // bs, maxb - 1)], 0)
+            off = pos % bs
+            kp = kp.at[layer, bid, off].set(kq)            # [C, heads, d]
+            vp = vp.at[layer, bid, off].set(vq)
+            s = scale if scale is not None else 1.0 / np.sqrt(d)
+            qf = qa[0].astype(jnp.float32)                 # [C, heads, d]
+            end = jnp.minimum(s0 + C, n)                   # past-last pos
+            hw_blocks = jnp.maximum(end - 1, 0) // bs + 1  # traced
+
+            def body(j, carry):
+                m, l, acc = carry
+                b = row[j]
+                keys = kp[layer, b].astype(jnp.float32)  # [bs, heads, d]
+                vals = vp[layer, b].astype(jnp.float32)
+                ks, vs = sc[layer, b, 0], sc[layer, b, 1]
+                logits = jnp.einsum(
+                    "chd,khd->hck", qf, keys,
+                    preferred_element_type=jnp.float32) * s
+                logits = logits * ks           # fused dequant (K)
+                allowed = (j * bs + jnp.arange(bs))[None, :] \
+                    <= pos[:, None]
+                logits = jnp.where(allowed[None, :, :], logits, -1e30)
+                m_new = jnp.maximum(m, jnp.max(logits, axis=-1,
+                                               keepdims=True))
+                p = jnp.exp(logits - m_new)    # [heads, C, bs]
+                alpha = jnp.exp(m - m_new)
+                l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+                pv = jnp.einsum("hck,khd->hcd", p, vals,
+                                preferred_element_type=jnp.float32)
+                return m_new, l_new, acc * alpha + pv * vs
+
+            m0 = jnp.full((heads, C, 1), -1e30, jnp.float32)
+            l0 = jnp.zeros((heads, C, 1), jnp.float32)
+            acc0 = jnp.zeros((heads, C, d), jnp.float32)
+            _, l, acc = jax.lax.fori_loop(0, hw_blocks, body,
+                                          (m0, l0, acc0))
+            out = (acc / jnp.maximum(l, 1e-30)).astype(qa.dtype)
+            return out.transpose(1, 0, 2)[None], kp, vp, sc
+
+        return apply("paged_prefill_chunk", fnq, q, k, v, kpool,
+                     vpool, scales, block_row, start, plen)
 
     def fn(qa, ka, va, kp, vp, row, s0, n):
         C = qa.shape[1]
@@ -468,12 +886,15 @@ register_contract(TraceContract(
     donate_argnums=introspect.ENGINE_COW_DONATE_ARGNUMS))
 
 
-def copy_pool_block(kpool, vpool, src, dst):
+def copy_pool_block(kpool, vpool, src, dst, scales=None):
     """Copy one block's KV rows across every layer plane: the engine's
     copy-on-write step. `src`/`dst` may be traced scalars, so the
     engine compiles this ONCE and reuses it for every COW promotion
-    (donated pools: XLA rewrites the dst rows in place in HBM). Raw
-    jnp arrays in/out — this is a compiled-step body, not a user op."""
+    (donated pools: XLA rewrites the dst rows in place in HBM). With
+    `scales` (int8 pools) the block's per-layer K/V scale rows ride
+    along — a COW copy of quantized KV without its grid would
+    dequantize on the destination's stale scale. Raw jnp arrays
+    in/out — this is a compiled-step body, not a user op."""
     srows = jax.lax.dynamic_index_in_dim(kpool, src, axis=1,
                                          keepdims=False)
     kpool = jax.lax.dynamic_update_index_in_dim(kpool, srows, dst,
@@ -482,20 +903,36 @@ def copy_pool_block(kpool, vpool, src, dst):
                                          keepdims=False)
     vpool = jax.lax.dynamic_update_index_in_dim(vpool, srows, dst,
                                                 axis=1)
-    return kpool, vpool
+    if scales is None:
+        return kpool, vpool
+    srow = jax.lax.dynamic_index_in_dim(scales, src, axis=1,
+                                        keepdims=False)
+    scales = jax.lax.dynamic_update_index_in_dim(scales, srow, dst,
+                                                 axis=1)
+    return kpool, vpool, scales
 
 
-def dense_gather_reference(kpool, vpool, layer, block_row, length):
+def dense_gather_reference(kpool, vpool, layer, block_row, length,
+                           scales=None):
     """Parity probe: reassemble one slot's first `length` cached k/v
     rows from the pools into dense `[length, heads, head_dim]` arrays
     (host-side, concrete values). Tests compare this against the dense
     fixed-buffer cache the single-request decode path carries — and,
     across two engines, against each other (the pallas-vs-dense pool
-    parity probe)."""
+    parity probe). With `scales` (int8 pools) the rows come back
+    DEQUANTIZED to f32 through the per-block grid."""
     kp = np.asarray(as_tensor(kpool)._array)[layer]
     vp = np.asarray(as_tensor(vpool)._array)[layer]
     row = np.asarray(as_tensor(block_row)._array)
     bs = kp.shape[1]
     pos = np.arange(int(length))
-    return (kp[row[pos // bs], pos % bs],
-            vp[row[pos // bs], pos % bs])
+    bids = row[pos // bs]
+    if scales is not None:
+        # int8 pools: reconstruct the fp rows through the per-block
+        # grid, so quantized parity probes compare VALUES, not codes
+        sc = np.asarray(as_tensor(scales)._array)[layer]
+        return (kp[bids, pos % bs].astype(np.float32)
+                * sc[bids, 0][:, None, None],
+                vp[bids, pos % bs].astype(np.float32)
+                * sc[bids, 1][:, None, None])
+    return (kp[bids, pos % bs], vp[bids, pos % bs])

@@ -160,15 +160,119 @@ def _decode_kernel(bt_ref, pos_ref, q_ref, knew_ref, vnew_ref,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def _decode_kernel_int8(bt_ref, pos_ref, sref, q_ref, knew_ref,
+                        vnew_ref, kpool_in, vpool_in, o_ref, kpool_ref,
+                        vpool_ref, kbuf, vbuf, copy_sems, write_sems,
+                        *, layer, block_size, scale):
+    """int8 edition of `_decode_kernel`: the pools hold int8 codes and
+    `sref` is this LAYER's per-block `[num_blocks, 2]` K/V scale plane,
+    scalar-prefetched with the block tables. knew/vnew arrive ALREADY
+    quantized (the op seam runs quant-on-write: grid grow + requantize
+    + scale update happen before the kernel, so the fused write DMA
+    below lands the final int8 bytes). Dequant is fused into the
+    streamed-block matmuls — int8 codes cast to f32 once in VMEM and
+    each block's logits/PV scaled by ITS grid — with the fp32 online
+    softmax unchanged; the operation order mirrors `_dense_step_q`
+    exactly so both backends agree token-for-token."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = pl.program_id(0)
+    pos = pos_ref[s]
+    last_blk = pos // block_size
+    nblk = last_blk + 1
+
+    wk = pltpu.make_async_copy(
+        knew_ref.at[0],
+        kpool_ref.at[layer, bt_ref[s, last_blk], pos % block_size],
+        write_sems.at[0])
+    wv = pltpu.make_async_copy(
+        vnew_ref.at[0],
+        vpool_ref.at[layer, bt_ref[s, last_blk], pos % block_size],
+        write_sems.at[1])
+    wk.start()
+    wv.start()
+
+    def kv_copies(j, buf):
+        bid = bt_ref[s, j]
+        return (pltpu.make_async_copy(kpool_ref.at[layer, bid],
+                                      kbuf.at[buf], copy_sems.at[0, buf]),
+                pltpu.make_async_copy(vpool_ref.at[layer, bid],
+                                      vbuf.at[buf], copy_sems.at[1, buf]))
+
+    def start_copies(j, buf):
+        ck, cv = kv_copies(j, buf)
+        ck.start()
+        cv.start()
+
+    @pl.when(last_blk == 0)
+    def _first_is_last():           # 1-block walk: copy needs the write
+        wk.wait()
+        wv.wait()
+        start_copies(0, 0)
+
+    @pl.when(last_blk > 0)
+    def _first():                   # block 0 is write-independent
+        start_copies(0, 0)
+
+    q = q_ref[0].astype(jnp.float32)            # [heads, D]
+    heads, head_dim = q.shape
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            @pl.when(j + 1 == last_blk)
+            def _writes_land_first():   # exactly once per program
+                wk.wait()
+                wv.wait()
+
+            start_copies(j + 1, (j + 1) % 2)
+
+        ck, cv = kv_copies(j, j % 2)
+        ck.wait()
+        cv.wait()
+        bid = bt_ref[s, j]
+        ks, vs = sref[bid, 0], sref[bid, 1]     # this block's grid
+        k = kbuf[j % 2].astype(jnp.float32)     # [bs, heads, D]
+        v = vbuf[j % 2].astype(jnp.float32)
+        sc = jnp.einsum("hd,khd->hk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+        sc = sc * ks                            # fused dequant (K)
+        gpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (heads, block_size), 1)
+        sc = jnp.where(gpos <= pos, sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)                 # [heads, bs] fp32
+        alpha = jnp.exp(m - m_new)              # [heads, 1]
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("hk,khd->hd", p, v,
+                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv * vs
+
+    m0 = jnp.full((heads, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((heads, 1), jnp.float32)
+    acc0 = jnp.zeros((heads, head_dim), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
 def paged_decode_attention(q, knew, vnew, kpool, vpool, layer,
                            block_tables, positions, scale=None,
-                           interpret: bool = False):
+                           interpret: bool = False, kv_scales=None):
     """Fused paged decode attention over the global pool, one layer.
 
     q/knew/vnew: `[slots, 1, heads, head_dim]` — this step's
     projections. kpool/vpool: `[layers, num_blocks, block_size, heads,
     head_dim]`. layer: python int (static). block_tables
     `[slots, max_blocks]` int32; positions `[slots]` int32.
+
+    `kv_scales` switches on the int8 path: the pools are int8 codes,
+    knew/vnew arrive ALREADY quantized by the op seam, and `kv_scales`
+    is this layer's `[num_blocks, 2]` per-block K/V grid, ridden as a
+    third scalar-prefetch operand and fused into the streamed-block
+    matmuls.
 
     Returns `(out [slots, 1, heads, head_dim], new_kpool, new_vpool)`
     with the pools updated in place when XLA can alias them (the
@@ -188,11 +292,21 @@ def paged_decode_attention(q, knew, vnew, kpool, vpool, layer,
     k3 = knew.reshape(slots, heads, head_dim).astype(kpool.dtype)
     v3 = vnew.reshape(slots, heads, head_dim).astype(vpool.dtype)
 
-    kernel = functools.partial(_decode_kernel, layer=int(layer),
-                               block_size=block_size, scale=scale)
+    if kv_scales is not None:
+        kernel = functools.partial(_decode_kernel_int8,
+                                   layer=int(layer),
+                                   block_size=block_size, scale=scale)
+        prefetch = (block_tables.astype(jnp.int32),
+                    positions.astype(jnp.int32),
+                    kv_scales.astype(jnp.float32))
+    else:
+        kernel = functools.partial(_decode_kernel, layer=int(layer),
+                                   block_size=block_size, scale=scale)
+        prefetch = (block_tables.astype(jnp.int32),
+                    positions.astype(jnp.int32))
     row = lambda s, *_: (s, 0, 0)  # noqa: E731 — per-slot [1,heads,D]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,     # block_tables, positions
+        num_scalar_prefetch=len(prefetch),  # tables, positions[, scales]
         grid=(slots,),
         in_specs=[
             pl.BlockSpec((1, heads, head_dim), row),
@@ -221,14 +335,15 @@ def paged_decode_attention(q, knew, vnew, kpool, vpool, layer,
             jax.ShapeDtypeStruct(kpool.shape, kpool.dtype),
             jax.ShapeDtypeStruct(vpool.shape, vpool.dtype),
         ],
-        # flat input order: bt, pos, q, knew, vnew, kpool, vpool — the
-        # pools alias outputs 1/2 so the fused write mutates in place
-        input_output_aliases={5: 1, 6: 2},
+        # flat input order: bt, pos[, scales], q, knew, vnew, kpool,
+        # vpool — the pools alias outputs 1/2 so the fused write
+        # mutates in place
+        input_output_aliases={len(prefetch) + 3: 1,
+                              len(prefetch) + 4: 2},
         compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
-      q3, k3, v3, kpool, vpool)
+    )(*prefetch, q3, k3, v3, kpool, vpool)
     return out.reshape(slots, 1, heads, head_dim), new_kpool, new_vpool
 
 
@@ -346,9 +461,122 @@ def _verify_kernel(bt_ref, pos_ref, dlen_ref, q_ref, knew_ref, vnew_ref,
         .transpose(1, 0, 2).astype(o_ref.dtype)
 
 
+def _verify_kernel_int8(bt_ref, pos_ref, dlen_ref, sref, q_ref,
+                        knew_ref, vnew_ref, kpool_in, vpool_in, o_ref,
+                        kpool_ref, vpool_ref, kbuf, vbuf, copy_sems,
+                        write_sems, *, layer, block_size, scale,
+                        max_blocks):
+    """int8 edition of `_verify_kernel`: `sref` is this layer's
+    per-block `[num_blocks, 2]` K/V grid (4th scalar-prefetch operand)
+    and knew/vnew arrive already quantized by the op seam's window
+    quant-on-write. Same write/stream choreography; dequant fused into
+    the streamed-block matmuls in `_dense_verify_q`'s exact operation
+    order."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = pl.program_id(0)
+    pos = pos_ref[s]
+    dlen = dlen_ref[s]
+    W = q_ref.shape[1]                  # static window width
+    first_wb = pos // block_size        # first block the window writes
+    last_blk = (pos + dlen) // block_size
+    nblk = last_blk + 1
+
+    writes = []
+    for i in range(W):
+        wpos = pos + i
+        live = i <= dlen
+        bid = jnp.where(
+            live,
+            bt_ref[s, jnp.minimum(wpos // block_size, max_blocks - 1)],
+            0)
+        off = wpos % block_size
+        wk = pltpu.make_async_copy(knew_ref.at[0, i],
+                                   kpool_ref.at[layer, bid, off],
+                                   write_sems.at[0, i])
+        wv = pltpu.make_async_copy(vnew_ref.at[0, i],
+                                   vpool_ref.at[layer, bid, off],
+                                   write_sems.at[1, i])
+        wk.start()
+        wv.start()
+        writes.append((wk, wv))
+
+    def wait_writes():
+        for wk, wv in writes:
+            wk.wait()
+            wv.wait()
+
+    def kv_copies(j, buf):
+        bid = bt_ref[s, j]
+        return (pltpu.make_async_copy(kpool_ref.at[layer, bid],
+                                      kbuf.at[buf], copy_sems.at[0, buf]),
+                pltpu.make_async_copy(vpool_ref.at[layer, bid],
+                                      vbuf.at[buf], copy_sems.at[1, buf]))
+
+    def start_copies(j, buf):
+        ck, cv = kv_copies(j, buf)
+        ck.start()
+        cv.start()
+
+    @pl.when(first_wb == 0)
+    def _writes_cover_first():      # window touches block 0: land first
+        wait_writes()
+        start_copies(0, 0)
+
+    @pl.when(first_wb > 0)
+    def _first():                   # block 0 is write-independent
+        start_copies(0, 0)
+
+    q = q_ref[0].astype(jnp.float32)            # [W, heads, D]
+    _, heads, head_dim = q.shape
+
+    def body(j, carry):
+        m, l, acc = carry
+
+        @pl.when(j + 1 < nblk)
+        def _prefetch():
+            @pl.when(j + 1 == first_wb)
+            def _writes_land_first():   # at most once per program
+                wait_writes()
+
+            start_copies(j + 1, (j + 1) % 2)
+
+        ck, cv = kv_copies(j, j % 2)
+        ck.wait()
+        cv.wait()
+        bid = bt_ref[s, j]
+        ks, vs = sref[bid, 0], sref[bid, 1]     # this block's grid
+        k = kbuf[j % 2].astype(jnp.float32)     # [bs, heads, D]
+        v = vbuf[j % 2].astype(jnp.float32)
+        sc = jnp.einsum("whd,khd->hwk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+        sc = sc * ks                            # fused dequant (K)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (W, block_size), 1)
+        qpos = pos + jax.lax.broadcasted_iota(
+            jnp.int32, (W, block_size), 0)
+        sc = jnp.where((kpos <= qpos)[None], sc, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_new)                 # [heads, W, bs] fp32
+        alpha = jnp.exp(m - m_new)              # [heads, W, 1]
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("hwk,khd->hwd", p, v,
+                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv * vs
+
+    m0 = jnp.full((heads, W, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((heads, W, 1), jnp.float32)
+    acc0 = jnp.zeros((heads, W, head_dim), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nblk, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)) \
+        .transpose(1, 0, 2).astype(o_ref.dtype)
+
+
 def paged_verify_attention(q, knew, vnew, kpool, vpool, layer,
                            block_tables, positions, draft_lens,
-                           scale=None, interpret: bool = False):
+                           scale=None, interpret: bool = False,
+                           kv_scales=None):
     """Fused speculative-verify attention over the global pool, one
     layer.
 
@@ -376,12 +604,25 @@ def paged_verify_attention(q, knew, vnew, kpool, vpool, layer,
     k4 = knew.astype(kpool.dtype)
     v4 = vnew.astype(vpool.dtype)
 
-    kernel = functools.partial(_verify_kernel, layer=int(layer),
-                               block_size=block_size, scale=scale,
-                               max_blocks=max_blocks)
+    if kv_scales is not None:
+        kernel = functools.partial(_verify_kernel_int8,
+                                   layer=int(layer),
+                                   block_size=block_size, scale=scale,
+                                   max_blocks=max_blocks)
+        prefetch = (block_tables.astype(jnp.int32),
+                    positions.astype(jnp.int32),
+                    draft_lens.astype(jnp.int32),
+                    kv_scales.astype(jnp.float32))
+    else:
+        kernel = functools.partial(_verify_kernel, layer=int(layer),
+                                   block_size=block_size, scale=scale,
+                                   max_blocks=max_blocks)
+        prefetch = (block_tables.astype(jnp.int32),
+                    positions.astype(jnp.int32),
+                    draft_lens.astype(jnp.int32))
     row = lambda s, *_: (s, 0, 0, 0)  # noqa: E731 — [1, W, heads, D]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,     # block_tables, positions, draft_lens
+        num_scalar_prefetch=len(prefetch),  # bt, pos, dlen[, scales]
         grid=(slots,),
         in_specs=[
             pl.BlockSpec((1, W, heads, head_dim), row),
@@ -410,12 +651,13 @@ def paged_verify_attention(q, knew, vnew, kpool, vpool, layer,
             jax.ShapeDtypeStruct(kpool.shape, kpool.dtype),
             jax.ShapeDtypeStruct(vpool.shape, vpool.dtype),
         ],
-        # flat input order: bt, pos, dlen, q, knew, vnew, kpool, vpool
-        # — the pools alias outputs 1/2 so writes mutate in place
-        input_output_aliases={6: 1, 7: 2},
+        # flat input order: bt, pos, dlen[, scales], q, knew, vnew,
+        # kpool, vpool — the pools alias outputs 1/2 so writes mutate
+        # in place
+        input_output_aliases={len(prefetch) + 3: 1,
+                              len(prefetch) + 4: 2},
         compiler_params=pltpu.TPUCompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), positions.astype(jnp.int32),
-      draft_lens.astype(jnp.int32), q, k4, v4, kpool, vpool)
+    )(*prefetch, q, k4, v4, kpool, vpool)
     return out, new_kpool, new_vpool

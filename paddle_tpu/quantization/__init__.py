@@ -41,8 +41,14 @@ def quantize_absmax(w, bits=8, axis=None):
     return q, scale
 
 
-def dequantize(q, scale):
-    return q.astype(jnp.float32) * scale
+def dequantize(q, scale, dtype=None):
+    """Rebuild a float array from int8 values + scale. `dtype` is the
+    OUTPUT dtype (default float32, the legacy contract): passing the
+    model's compute dtype dequantizes straight to it — one multiply,
+    no second cast at the call site (the int8 weight-serving path
+    dequantizes per-tile inside the compiled step this way)."""
+    dt = jnp.float32 if dtype is None else dtype
+    return q.astype(dt) * jnp.asarray(scale).astype(dt)
 
 
 class _FakeQuantSTE(PyLayer):

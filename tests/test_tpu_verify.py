@@ -154,6 +154,52 @@ def test_tpu103_fp32_operands_never_flagged():
     assert check_tpu103(prog) == []
 
 
+def _jaxpr_prog(fn, args, contract=None):
+    """TracedProgram from make_jaxpr alone (no lowering) — for
+    fixtures whose exotic dtype combinations the CPU backend need not
+    compile; TPU103 reads only the jaxpr."""
+    return TracedProgram(
+        contract=contract or _contract(), config="fixture", mp=1,
+        num_layers=1, jaxpr=jax.make_jaxpr(fn)(*args),
+        lowered_text="", donated_leaves=0)
+
+
+def _int8_dot(a, b, accum):
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=accum)
+
+
+def test_tpu103_int8_positive_narrow_accumulation():
+    """The quantized-serving contract (ISSUE 11): an int8 dot_general
+    accumulating in bf16 — or staying int8 — fires; quantization
+    already spent the narrow bits once, the accumulator must not
+    spend them again."""
+    a = jnp.ones((4, 8), jnp.int8)
+    b = jnp.ones((8, 4), jnp.int8)
+    found = check_tpu103(_jaxpr_prog(
+        lambda x, y: _int8_dot(x, y, jnp.bfloat16), (a, b)))
+    assert [f.rule for f in found] == ["TPU103"]
+    assert "int8/int8" in found[0].message \
+        and "bfloat16" in found[0].message
+    found = check_tpu103(_jaxpr_prog(
+        lambda x, y: _int8_dot(x, y, None), (a, b)))  # stays int8
+    assert [f.rule for f in found] == ["TPU103"]
+
+
+def test_tpu103_int8_negative_wide_accumulation():
+    """int8 operands accumulating in fp32 (the engine's dequantized
+    matmuls' pinned policy) or exact int32 pass."""
+    a = jnp.ones((4, 8), jnp.int8)
+    b = jnp.ones((8, 4), jnp.int8)
+    for accum in (jnp.float32, jnp.int32):
+        prog = _jaxpr_prog(lambda x, y: _int8_dot(x, y, accum), (a, b))
+        assert check_tpu103(prog) == [], accum
+    # int32 token ids are NOT narrow — reductions over them are fine
+    ids = jnp.ones((16,), jnp.int32)
+    assert check_tpu103(_jaxpr_prog(lambda x: jnp.sum(x), (ids,))) \
+        == []
+
+
 # -- TPU104 collective-budget -------------------------------------------
 
 def _gather_fn(n_gathers):

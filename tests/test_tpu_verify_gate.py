@@ -64,10 +64,13 @@ def test_matrix_is_contract_clean(matrix_result):
     new = res.new_findings()
     assert new == [], "tpu-verify findings:\n" + "\n".join(
         f.render() for f in new)
-    # the matrix must actually cover the serving stack: the 8
-    # backend/K-divergent decode/verify steps plus the 6 per-mp
-    # backend-invariant programs, every contract seen
-    assert len(res.programs) == 14
+    # the matrix must actually cover the serving stack: the 16
+    # backend/K/kv-divergent decode/verify steps plus the 12 per-
+    # (mp, kv_dtype) backend-invariant programs, every contract seen
+    # — the kv=int8 half is the PR-11 quantized serving config (int8
+    # per-block-scaled KV pools + int8 weights)
+    assert len(res.programs) == 28
+    assert sum(",int8" in p.config for p in res.programs) == 14
     names = {p.contract.name for p in res.programs}
     assert names == {"engine_decode_step", "engine_verify_step",
                      "engine_prefill", "engine_prefill_chunk",
@@ -222,4 +225,4 @@ def test_cli_acceptance_command_exits_zero():
         [sys.executable, os.path.join(REPO, "tools", "tpu_verify.py")],
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "tpu-verify clean: 14 programs" in res.stdout
+    assert "tpu-verify clean: 28 programs" in res.stdout

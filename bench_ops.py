@@ -103,7 +103,7 @@ SUITE_ROWS = (
     "paged_attention_decode_sweep", "gpt_engine_offered_load_pallas",
     "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill",
     "gpt_engine_speculative", "gpt_engine_offered_load_mp2",
-    "gpt_engine_offered_load_int8",
+    "gpt_engine_offered_load_int8", "gpt_fleet_offered_load",
 )
 
 
@@ -207,6 +207,7 @@ def suite():
         mp_degree=2)
     cases["gpt_engine_offered_load_int8"] = _engine_offered_load_case(
         kv_dtype="int8")
+    cases["gpt_fleet_offered_load"] = _fleet_offered_load_case()
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -610,15 +611,161 @@ def _engine_offered_load_case(model_cfg=None, requests=None, num_slots=8,
 def _tpot_pct(snap, q):
     """Tail TPOT from the engine's histogram, counts summed across the
     priority-labeled series (ms, or None before any observation)."""
+    return _hist_pct(snap, "engine_tpot_seconds", q)
+
+
+def _hist_pct(snap, name, q):
+    """Quantile of any snapshot histogram with counts summed across
+    ALL its labeled series (priority/replica/...): the fleet-level
+    percentile view (ms, or None before any observation)."""
     from paddle_tpu.observability.metrics import quantile_from_buckets
 
-    fam = snap["engine_tpot_seconds"]
+    fam = snap[name]
     if not fam["series"]:
         return None
     counts = [sum(s["counts"][i] for s in fam["series"])
               for i in range(len(fam["series"][0]["counts"]))]
     v = quantile_from_buckets(fam["buckets"], counts, q)
     return None if v is None else round(v * 1e3, 3)
+
+
+def _fleet_offered_load_case(model_cfg=None, num_tenants=3,
+                             per_tenant=8, uniques=6, prefix_len=64,
+                             suffix_max=32, max_new=32, num_slots=8,
+                             block_size=16, prefill_chunk=64, seed=0,
+                             replica_counts=(1, 2)):
+    """Serving-tier offered-load row (ISSUE 12): the SAME skewed
+    multi-tenant trace served by a 1-replica and an N-replica
+    `ServingFleet` (prefix-affinity dp router over engine replicas,
+    inference/fleet.py). The trace is deliberately skewed — tenant 0's
+    hot shared system prompt carries ~half the requests, later tenants
+    halve, plus a long-tail of one-off prompts — the shape where
+    affinity routing either pays (hot prefixes stay on the replica
+    owning their warm blocks) or collapses a replica (no hysteresis).
+    Each fleet serves the trace twice: the COLD wave is the tracked
+    offered-load number per replica count, the WARM wave (fresh
+    suffixes, same tenants) must route hot tenants onto their warm
+    blocks — the runner ASSERTS merged prefix-cache hit tokens AND
+    router affinity tokens > 0, and asserts every request's output
+    token-identical across replica counts (the fleet exactness
+    contract at bench scale). Tracked numbers: aggregate cold
+    tokens/s at each replica count, warm tokens/s, p99 TTFT/TPOT from
+    the replica-labeled merged snapshot."""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.inference import ServingFleet
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.observability.metrics import series_total
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        tenants = [rng.randint(0, cfg.vocab_size, prefix_len)
+                   for _ in range(num_tenants)]
+
+        def wave():
+            # skewed: tenant t carries per_tenant >> t requests
+            reqs = []
+            lo = max(1, min(8, max_new))
+            for t, pre in enumerate(tenants):
+                for _ in range(max(1, per_tenant >> t)):
+                    sfx = rng.randint(0, cfg.vocab_size,
+                                      rng.randint(1, suffix_max + 1))
+                    reqs.append((np.concatenate([pre, sfx]),
+                                 int(rng.randint(lo, max_new + 1))))
+            for _ in range(uniques):
+                reqs.append((rng.randint(
+                    0, cfg.vocab_size,
+                    rng.randint(prefix_len // 2, prefix_len * 2)),
+                    int(rng.randint(lo, max_new + 1))))
+            return reqs
+
+        # both waves fixed up front so every fleet serves the same
+        # bytes — the cross-replica-count identity assert needs it
+        trace_cold, trace_warm = wave(), wave()
+
+        def fleet_tokens(fleet):
+            return sum(r.engine.tokens_generated
+                       for r in fleet._replicas.values())
+
+        def serve(fleet, trace):
+            base = fleet_tokens(fleet)
+            t0 = time.perf_counter()
+            ids = [fleet.add_request(p, max_new_tokens=n)
+                   for p, n in trace]
+            out = fleet.run()
+            dt = time.perf_counter() - t0
+            assert len(out) == len(trace)
+            return dt, fleet_tokens(fleet) - base, \
+                [list(map(int, out[i])) for i in ids]
+
+        results, outs_by_n = {}, {}
+        for n in replica_counts:
+            fleet = ServingFleet(model, num_replicas=n,
+                                 num_slots=num_slots,
+                                 block_size=block_size,
+                                 prefill_chunk=prefill_chunk)
+            eng0 = fleet._any_engine()
+            if eng0.kv_dtype is not None or eng0.mp_degree != 1:
+                # an env knob would silently change every replica,
+                # making the replica-count comparison a lie
+                raise RuntimeError(
+                    "fleet bench replicas resolved kv_dtype="
+                    f"{eng0.kv_dtype!r}/mp={eng0.mp_degree} (is a "
+                    "PADDLE_SERVE_* env set?) — unset it to run this "
+                    "row")
+            # compile warmup per replica, off the record
+            for rep in fleet._replicas.values():
+                rep.engine.add_request(
+                    rng.randint(0, cfg.vocab_size, prefill_chunk + 1),
+                    max_new_tokens=2)
+                rep.engine.run()
+            fleet.reset_metrics()
+            dt_cold, toks_cold, outs_cold = serve(fleet, trace_cold)
+            snap = fleet.metrics_snapshot()
+            ttft99 = _hist_pct(snap, "engine_ttft_seconds", 0.99)
+            tpot99 = _hist_pct(snap, "engine_tpot_seconds", 0.99)
+            fleet.reset_metrics()
+            dt_warm, toks_warm, outs_warm = serve(fleet, trace_warm)
+            snap = fleet.metrics_snapshot()
+            hit = int(series_total(
+                snap, "engine_prefix_cache_hit_tokens_total"))
+            aff = int(series_total(
+                snap, "fleet_affinity_hit_tokens_total"))
+            assert hit > 0, \
+                "warm wave must serve prefix-cache hits fleet-wide"
+            assert aff > 0, \
+                ("warm wave must land affinity routes (hot tenants "
+                 "onto their block-owning replica)")
+            outs_by_n[n] = outs_cold + outs_warm
+            results[n] = {
+                "tokens_per_s": round(toks_cold / dt_cold),
+                "tokens_per_s_warm": round(toks_warm / dt_warm),
+                "ms": round(dt_cold * 1e3, 1),
+                "ttft_ms_p99": ttft99, "tpot_ms_p99": tpot99,
+                "affinity_hit_tokens": aff,
+                "prefix_hit_tokens": hit}
+        base_n = replica_counts[0]
+        for n in replica_counts[1:]:
+            assert outs_by_n[n] == outs_by_n[base_n], \
+                (f"fleet outputs diverged between replicas={base_n} "
+                 f"and replicas={n}")
+        head = results[replica_counts[-1]]
+        return {**head,
+                "replicas": replica_counts[-1],
+                "requests": len(trace_cold) + len(trace_warm),
+                **{f"tokens_per_s_r{n}": results[n]["tokens_per_s"]
+                   for n in replica_counts}}
+
+    return run_bench
 
 
 def _engine_prefix_cache_case(model_cfg=None, num_tenants=4,

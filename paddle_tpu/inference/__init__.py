@@ -21,12 +21,14 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.inference.engine import (PRIORITY_CLASSES,
                                          GenerationEngine, PagedKVCache,
-                                         Request)
+                                         Request, prefix_key)
+from paddle_tpu.inference.fleet import REPLICA_ROLES, ServingFleet
 from paddle_tpu.inference.speculative import NgramDrafter
 
 __all__ = ["Config", "Predictor", "create_predictor", "DistModel",
            "DistModelConfig", "GenerationEngine", "PagedKVCache",
-           "Request", "PRIORITY_CLASSES", "NgramDrafter"]
+           "Request", "PRIORITY_CLASSES", "NgramDrafter",
+           "ServingFleet", "REPLICA_ROLES", "prefix_key"]
 
 
 def _stream_micro_batches(forward, ins, mbs, pad_to=1):

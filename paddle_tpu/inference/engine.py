@@ -164,7 +164,38 @@ from paddle_tpu.observability.metrics import LATENCY_BUCKETS, \
 from paddle_tpu.profiler import RecordEvent
 
 __all__ = ["PagedKVCache", "GenerationEngine", "Request",
-           "PRIORITY_CLASSES"]
+           "PRIORITY_CLASSES", "prefix_key", "iter_prefix_key"]
+
+
+def iter_prefix_key(tokens, block_size):
+    """Lazy form of `prefix_key`: yields the chain digests one full
+    block at a time, so walkers that break at the first cache miss
+    (`match_prefix`, `warm_prefix_tokens` on a cold cache) hash only
+    as deep as they look."""
+    tokens = np.asarray(tokens, np.int32)
+    bs = int(block_size)
+    h = b""
+    for i in range(len(tokens) // bs):
+        h = hashlib.blake2b(
+            h + tokens[i * bs:(i + 1) * bs].tobytes(),
+            digest_size=16).digest()
+        yield h
+
+
+def prefix_key(tokens, block_size):
+    """Chain digests over the FULL blocks of `tokens`: digest `i` is
+    blake2b(digest[i-1] ‖ block_i_tokens), so a digest names a block's
+    content AND its whole prefix — position/prefix-safe by
+    construction. Returns a tuple of 16-byte digests, one per full
+    block (the ragged tail contributes nothing).
+
+    This is the ONE hashing truth shared by the prefix cache
+    (`PagedKVCache.match_prefix`/`register_prefix` key their block map
+    with these digests) and the fleet router
+    (`inference.fleet.ServingFleet` steers a request to the replica
+    whose cache owns the deepest digest of its prompt) — factored out
+    so the two can never drift: a router key IS a cache key."""
+    return tuple(iter_prefix_key(tokens, block_size))
 
 
 class PagedKVCache:
@@ -376,21 +407,15 @@ class PagedKVCache:
         refcount, or registered as cached prefix content."""
         return self._ref[block] > 1 or block in self._hash_of
 
-    def _chain_hash(self, prev, tokens):
-        return hashlib.blake2b(prev + np.asarray(tokens, np.int32)
-                               .tobytes(), digest_size=16).digest()
-
     def match_prefix(self, tokens):
         """Longest cached block-aligned prefix of `tokens`: walks the
-        chain hash over full blocks, takes a reference on every hit
-        (reviving evictable ones), and returns (blocks, hit_tokens).
-        Hit tokens never need recomputing — their KV is already in the
-        pool, byte-for-byte what this prompt's prefill would write."""
-        tokens = np.asarray(tokens, np.int32)
-        bs = self.block_size
-        blocks, h = [], b""
-        for i in range(len(tokens) // bs):
-            h = self._chain_hash(h, tokens[i * bs:(i + 1) * bs])
+        `prefix_key` chain digests over full blocks, takes a reference
+        on every hit (reviving evictable ones), and returns
+        (blocks, hit_tokens). Hit tokens never need recomputing —
+        their KV is already in the pool, byte-for-byte what this
+        prompt's prefill would write."""
+        blocks = []
+        for h in iter_prefix_key(tokens, self.block_size):
             b = self._block_of.get(h)
             if b is None:
                 break
@@ -398,7 +423,24 @@ class PagedKVCache:
                 del self._evictable[b]     # revive: live again
             self._ref[b] += 1
             blocks.append(b)
-        return blocks, len(blocks) * bs
+        return blocks, len(blocks) * self.block_size
+
+    def warm_prefix_tokens(self, tokens, keys=None):
+        """Prompt tokens a `match_prefix` would serve from this cache
+        RIGHT NOW — a read-only peek (no references taken, evictable
+        entries left parked) for the fleet router's affinity decision:
+        the replica owning the deepest warm chain gets the request.
+        Same digests as `match_prefix` (both walk the `prefix_key`
+        chain), so a router hit is exactly a cache hit. `keys` lets a
+        caller probing SEVERAL caches (the router) hash the prompt
+        once and reuse the digests."""
+        hit = 0
+        for h in (keys if keys is not None
+                  else iter_prefix_key(tokens, self.block_size)):
+            if h not in self._block_of:
+                break
+            hit += self.block_size
+        return hit
 
     def register_prefix(self, tokens, blocks):
         """Publish a fully-prefilled prompt's FULL blocks into the
@@ -406,18 +448,34 @@ class PagedKVCache:
         is written). First writer wins: a hash that is already mapped
         keeps its original block and the racing copy stays private to
         its slot. Returns the number of blocks newly cached."""
-        tokens = np.asarray(tokens, np.int32)
-        bs = self.block_size
-        added, h = 0, b""
-        for i in range(min(len(tokens) // bs, len(blocks))):
-            h = self._chain_hash(h, tokens[i * bs:(i + 1) * bs])
-            b = int(blocks[i])
+        added = 0
+        keys = iter_prefix_key(tokens, self.block_size)
+        for h, blk in zip(keys, blocks):
+            b = int(blk)
             if h in self._block_of or b in self._hash_of:
                 continue
             self._block_of[h] = b
             self._hash_of[b] = h
             added += 1
         return added
+
+    def leak_check(self):
+        """Block-accounting audit for a QUIESCED pool (no live slots):
+        every non-null block must either sit on the free list or be a
+        refcount-zero prefix-cache block parked in the evictable LRU.
+        Returns the list of leaked block ids — blocks still referenced
+        or unaccounted for. `GenerationEngine.drain()` asserts this
+        empty: it catches the leak class the allocator's double-free
+        hardening cannot see (a block freed zero times instead of
+        twice)."""
+        free = set(self._free)
+        leaked = []
+        for b in range(1, self.num_blocks):
+            if self._ref[b] == 0 and (
+                    b in free or b in self._evictable):
+                continue
+            leaked.append(b)
+        return leaked
 
 
 # admission QoS classes, best-served-first; add_request validates
@@ -437,6 +495,11 @@ class Request:
     eos_token_id: int = None
     arrived_at: float = None           # perf_counter at add_request
     priority: str = "standard"         # one of PRIORITY_CLASSES
+    # disaggregated serving: a prefill-only request runs the prompt to
+    # completion, emits its FIRST token, then parks its KV blocks in
+    # the engine's handoff buffer (take_handoff) instead of decoding —
+    # the fleet moves those blocks into a decode replica's pool
+    prefill_only: bool = False
 
 
 @dataclass(eq=False)
@@ -667,6 +730,8 @@ class GenerationEngine:
         self._queues = {p: deque() for p in PRIORITY_CLASSES}
         self._slots = [None] * self.num_slots
         self._results = {}
+        self._handoffs = {}            # req_id -> (blocks, hit_tokens)
+        self._draining = False
         self._auto_id = 0
         self._admit_counter = 0
         self.tokens_generated = 0
@@ -1342,16 +1407,16 @@ class GenerationEngine:
         return self._prefill_pure.traces
 
     # -- request intake ----------------------------------------------------
-    def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    req_id=None, priority="standard"):
-        """Queue a request; admitted into a free slot between decode
-        iterations (may be called while `run`/`step` is mid-stream).
-        `priority` is one of PRIORITY_CLASSES — higher classes admit
-        first and survive saturation shedding longer. With `max_queue`
-        set and the queue full, the lowest-priority loser is shed: its
-        result is recorded as None (the HTTP-429 of this API) and
-        `engine_shed_total` counts it; the request kept is whichever
-        of (incoming, worst queued) ranks higher."""
+    def _intake_guard(self, prompt, max_new_tokens, priority, req_id):
+        """Shared admission validation + id claim for BOTH intake
+        paths (`add_request` and the fleet's `adopt_request`), so the
+        two can never drift: draining gate, prompt/budget/priority/
+        length checks, auto-id allocation with collision detection.
+        Returns the normalized (prompt, req_id)."""
+        if self._draining:
+            raise RuntimeError(
+                "engine is draining — admissions are closed (finish "
+                "the drain, or route to another replica)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1374,9 +1439,36 @@ class GenerationEngine:
         elif req_id in self._in_flight():
             raise ValueError(f"req_id {req_id!r} is already queued, "
                              "decoding, or awaiting collection")
+        return prompt, req_id
+
+    def add_request(self, prompt, max_new_tokens, eos_token_id=None,
+                    req_id=None, priority="standard",
+                    prefill_only=False):
+        """Queue a request; admitted into a free slot between decode
+        iterations (may be called while `run`/`step` is mid-stream).
+        `priority` is one of PRIORITY_CLASSES — higher classes admit
+        first and survive saturation shedding longer. With `max_queue`
+        set and the queue full, the lowest-priority loser is shed: its
+        result is recorded as None (the HTTP-429 of this API) and
+        `engine_shed_total` counts it; the request kept is whichever
+        of (incoming, worst queued) ranks higher.
+
+        `prefill_only=True` is the disaggregated-serving intake: the
+        engine prefills the prompt, emits the FIRST token, then parks
+        the prompt's KV blocks for `take_handoff` instead of decoding
+        further (`max_new_tokens` must be 1 — the fleet's decode
+        replica owns the rest of the budget)."""
+        if prefill_only and max_new_tokens != 1:
+            raise ValueError(
+                "prefill_only requests carry max_new_tokens=1 (the "
+                "single token the final prefill chunk yields); the "
+                "decode replica owns the remaining budget")
+        prompt, req_id = self._intake_guard(prompt, max_new_tokens,
+                                            priority, req_id)
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         req = Request(req_id, prompt, int(max_new_tokens), eos,
-                      arrived_at=time.perf_counter(), priority=priority)
+                      arrived_at=time.perf_counter(), priority=priority,
+                      prefill_only=bool(prefill_only))
         if self.max_queue is not None \
                 and self.num_pending >= self.max_queue:
             victim = self._shed_victim(priority)
@@ -1438,11 +1530,15 @@ class GenerationEngine:
 
     def _in_flight(self):
         """Ids that would collide with a new request: queued, seated in
-        a lane, or finished but not yet drained by run()."""
+        a lane, finished but not yet drained by run()/pop_results(),
+        or parked in the handoff buffer (a reused id there would
+        overwrite the parked entry and leak its still-referenced
+        blocks)."""
         ids = {r.req_id for p in PRIORITY_CLASSES
                for r in self._queues[p]}
         ids.update(s.req.req_id for s in self._slots if s is not None)
         ids.update(self._results)
+        ids.update(self._handoffs)
         return ids
 
     def _peek_request(self):
@@ -1492,10 +1588,28 @@ class GenerationEngine:
             # step's latency explicitly
             self._m_tpot.labels(priority=req.priority).observe(
                 now - t_step)
-            self._finish(slot, "eos" if done_eos else "length")
+            if req.prefill_only:
+                self._handoff_finish(slot)
+            else:
+                self._finish(slot, "eos" if done_eos else "length")
             self._slots[self._slots.index(slot)] = None
             return False
         return True
+
+    def _handoff_finish(self, slot):
+        """Retire a prefill-only lane WITHOUT freeing its blocks: the
+        prompt's fully-written KV is this request's product. The blocks
+        park in the handoff buffer (still referenced, so neither the
+        allocator nor LRU eviction can recycle them) until the fleet
+        claims them with `take_handoff`, exports their rows into a
+        decode replica's pool, and returns them via
+        `release_handoff`."""
+        req = slot.req
+        self._handoffs[req.req_id] = (list(slot.blocks),
+                                      slot.hit_tokens)
+        self._results[req.req_id] = \
+            list(map(int, req.prompt)) + slot.generated
+        self._m_finished.labels(reason="handoff").inc()
 
     # -- admission: chunked (default) --------------------------------------
     def _admit_chunked(self):
@@ -1723,7 +1837,13 @@ class GenerationEngine:
                     # in the TPOT histogram (producing-step latency)
                     self._m_tpot.labels(
                         priority=req.priority).observe(now - t_dec)
-                self._finish(slot, "eos" if done_eos else "length")
+                if req.prefill_only:
+                    # full-prefix-hit prefill-only lane: its one decode
+                    # step produced the first token — park the blocks
+                    # for the disaggregated handoff, don't free them
+                    self._handoff_finish(slot)
+                else:
+                    self._finish(slot, "eos" if done_eos else "length")
                 self._slots[i] = None
         return len(runnable)
 
@@ -1903,7 +2023,10 @@ class GenerationEngine:
                     # (the PR-6 TPOT contract)
                     self._m_tpot.labels(
                         priority=req.priority).observe(now - t_dec)
-                self._finish(slot, "eos" if done_eos else "length")
+                if req.prefill_only:
+                    self._handoff_finish(slot)
+                else:
+                    self._finish(slot, "eos" if done_eos else "length")
                 self._slots[i] = None
         return len(runnable)
 
@@ -1936,6 +2059,117 @@ class GenerationEngine:
     @property
     def num_pending(self):
         return sum(len(self._queues[p]) for p in PRIORITY_CLASSES)
+
+    @property
+    def free_lanes(self):
+        """Decode lanes currently vacant — the fleet's adopt/seat
+        headroom signal."""
+        return self._slots.count(None)
+
+    def pop_results(self):
+        """Drain finished results incrementally: {req_id: tokens} for
+        every request that finished since the last pop (None = shed).
+        The fleet's collection path — it drives `step()` itself and
+        must see finishes as they happen, not at end-of-trace like
+        `run()` (which empties the same buffer)."""
+        out, self._results = self._results, {}
+        return out
+
+    # -- disaggregated prefill/decode (fleet handoff) ----------------------
+    def take_handoff(self, req_id):
+        """Claim a finished prefill-only request's parked KV footprint:
+        returns (block ids, prefix-cache hit tokens). The caller owns
+        the blocks' references now — export their rows (the
+        `ops.paged_attention.export_pool_block` / `ingest_pool_block`
+        pair is the transfer unit), then hand them back with
+        `release_handoff`."""
+        return self._handoffs.pop(req_id)
+
+    def release_handoff(self, blocks):
+        """Return a handed-off request's source blocks to the pool
+        once their payload is exported. Prefix-cached blocks park in
+        the evictable LRU (still matchable — the warm chain the fleet
+        router steers toward survives the handoff); private blocks go
+        back to the free list."""
+        self.cache.free(blocks)
+        self._update_pool_gauges()
+
+    def adopt_request(self, prompt, first_token, blocks,
+                      max_new_tokens, eos_token_id=None, req_id=None,
+                      priority="standard", arrived_at=None):
+        """Seat a request whose prompt KV is ALREADY in this engine's
+        pool — the decode-side intake of disaggregated serving. The
+        fleet allocates `blocks` from this engine's cache, ingests the
+        prefill replica's exported rows into them, then adopts:
+        `first_token` (the token the remote final prefill chunk
+        produced) seeds the lane and decode continues exactly as if
+        the prefill had run here — same compiled steps, same pool
+        contents, token-identical output. `max_new_tokens` is the
+        request's ORIGINAL budget (the first token counts against it).
+        Raises when no lane is free (check `free_lanes` first) — the
+        fleet, not the engine, owns handoff queueing. The first token
+        is not re-counted in `tokens_generated` (its producing replica
+        already counted it)."""
+        prompt, req_id = self._intake_guard(prompt, max_new_tokens,
+                                            priority, req_id)
+        need = math.ceil(prompt.size / self.block_size)
+        if len(blocks) != need:
+            raise ValueError(
+                f"adopted prompt of {prompt.size} tokens needs exactly "
+                f"{need} block(s), got {len(blocks)}")
+        if None not in self._slots:
+            raise RuntimeError(
+                "no free lane to adopt into — check free_lanes before "
+                "handing off")
+        eos = self.eos_token_id if eos_token_id is None \
+            else eos_token_id
+        req = Request(req_id, prompt, int(max_new_tokens), eos,
+                      arrived_at=arrived_at, priority=priority)
+        now = time.perf_counter()
+        slot = _Slot(req=req, blocks=[int(b) for b in blocks],
+                     generated=[int(first_token)],
+                     last_token_at=now, prefill_pos=int(prompt.size),
+                     admit_seq=self._admit_counter)
+        self._admit_counter += 1
+        self._slots[self._slots.index(None)] = slot
+        self._m_admissions.inc()
+        self._update_pool_gauges()
+        done_eos = (eos is not None and int(first_token) == eos)
+        if done_eos or int(max_new_tokens) <= 1:
+            # already complete on arrival (EOS'd or single-token
+            # budget): retire immediately, blocks back to the pool
+            self._finish(slot, "eos" if done_eos else "length")
+            self._slots[self._slots.index(slot)] = None
+        self._m_active.set(self.num_active)
+        return req_id
+
+    def drain(self):
+        """Graceful replica shutdown: close admissions (add_request /
+        adopt_request raise from now on), run every queued and
+        in-flight request to completion, then AUDIT the pool — every
+        non-null block must be back on the free list or parked as a
+        refcount-zero prefix-cache block (`PagedKVCache.leak_check`).
+        A parked handoff fails the drain loudly: its blocks are
+        intentionally held, so the fleet must export-and-release
+        before retiring the replica. Returns the drained results
+        (run()'s contract). Catches the block-leak class the
+        allocator's double-free hardening cannot see — a block freed
+        zero times instead of twice."""
+        self._draining = True
+        out = self.run()
+        if self._handoffs:
+            raise RuntimeError(
+                f"{len(self._handoffs)} handoff(s) still parked — "
+                "take_handoff/release_handoff them before draining "
+                "the replica")
+        leaked = self.cache.leak_check()
+        if leaked:
+            raise RuntimeError(
+                f"drain leak check failed: block(s) {leaked} neither "
+                "free nor prefix-cached after all lanes finished — a "
+                "scheduler path dropped a reference without freeing")
+        self._end_of_step_gauges()
+        return out
 
     def run(self):
         """Drive until every queued/admitted request finished; returns

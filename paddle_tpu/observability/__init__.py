@@ -21,6 +21,7 @@ from .metrics import (
     LATENCY_BUCKETS,
     MetricsRegistry,
     get_registry,
+    label_snapshot,
     merge_snapshots,
     quantile_from_buckets,
     series_total,
@@ -32,7 +33,8 @@ from .training import TrainingTelemetry
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
-    "LATENCY_BUCKETS", "merge_snapshots", "quantile_from_buckets",
-    "series_total", "aggregate", "render_prometheus",
-    "parse_prometheus", "MetricsServer", "TrainingTelemetry",
+    "LATENCY_BUCKETS", "merge_snapshots", "label_snapshot",
+    "quantile_from_buckets", "series_total", "aggregate",
+    "render_prometheus", "parse_prometheus", "MetricsServer",
+    "TrainingTelemetry",
 ]

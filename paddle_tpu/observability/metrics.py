@@ -29,7 +29,8 @@ import threading
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "LATENCY_BUCKETS", "DEFAULT_BUCKETS",
-    "merge_snapshots", "quantile_from_buckets", "series_total",
+    "merge_snapshots", "label_snapshot", "quantile_from_buckets",
+    "series_total",
 ]
 
 # latency buckets (seconds): sub-ms decode steps through multi-second
@@ -426,6 +427,34 @@ def json_sanitize(obj):
 
 def _series_key(entry):
     return tuple(sorted(entry["labels"].items()))
+
+
+def label_snapshot(snap, **extra):
+    """Copy of a `MetricsRegistry.snapshot()` with `extra` labels
+    stamped onto every series (and appended to each family's
+    labelnames). The host-side relabeling half of fleet-level folding:
+    each replica engine keeps its own registry for counter exactness,
+    the fleet stamps `replica=<id>` here and folds the stamped
+    snapshots through `merge_snapshots` — identical label sets sum
+    exactly, the replica label keeps per-replica series side-by-side
+    (same semantics as the shard-labeled pool gauges). Raises on a
+    label-name collision instead of silently shadowing a real label."""
+    out = {}
+    for name, fam in snap.items():
+        clash = set(extra) & set(fam["labelnames"])
+        if clash:
+            raise ValueError(
+                f"metric {name!r} already carries label(s) "
+                f"{sorted(clash)} — relabeling would shadow them")
+        f = {"type": fam["type"], "help": fam["help"],
+             "labelnames": list(fam["labelnames"]) + sorted(extra),
+             "series": [dict(entry, labels=dict(entry["labels"],
+                                                **extra))
+                        for entry in fam["series"]]}
+        if fam["type"] == "histogram":
+            f["buckets"] = list(fam["buckets"])
+        out[name] = f
+    return out
 
 
 def merge_snapshots(snaps):

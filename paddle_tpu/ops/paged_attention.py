@@ -94,7 +94,8 @@ from .dispatch import apply, as_tensor
 
 __all__ = ["paged_attention_step", "paged_verify_window",
            "paged_prefill_write", "paged_prefill_chunk",
-           "copy_pool_block", "dense_gather_reference",
+           "copy_pool_block", "export_pool_block", "ingest_pool_block",
+           "dense_gather_reference",
            "resolve_backend", "PAGED_BACKENDS", "PAGED_PATH_STATS",
            "KV_QUANT_EPS"]
 
@@ -909,6 +910,51 @@ def copy_pool_block(kpool, vpool, src, dst, scales=None):
                                         keepdims=False)
     scales = jax.lax.dynamic_update_index_in_dim(scales, srow, dst,
                                                  axis=1)
+    return kpool, vpool, scales
+
+
+def export_pool_block(kpool, vpool, src, scales=None):
+    """Gather ONE block's KV rows across every layer plane out of a
+    pool: the disaggregated-serving transfer unit's READ half. `src`
+    is a traced scalar, so the fleet compiles this once per source
+    pool shape and reuses it for every handed-off block. Returns
+    (`[layers, block_size, heads, head_dim]` k rows, same-shape v
+    rows[, the block's `[layers, 2]` scale rows under int8 pools —
+    quantized codes without their grid would dequantize wrong on the
+    destination]). Pools are READ, never donated: the source replica
+    keeps serving from them. Raw jnp arrays in/out — a compiled-step
+    body, not a user op."""
+    kb = jax.lax.dynamic_index_in_dim(kpool, src, axis=1,
+                                      keepdims=False)
+    vb = jax.lax.dynamic_index_in_dim(vpool, src, axis=1,
+                                      keepdims=False)
+    if scales is None:
+        return kb, vb
+    srow = jax.lax.dynamic_index_in_dim(scales, src, axis=1,
+                                        keepdims=False)
+    return kb, vb, srow
+
+
+def ingest_pool_block(kpool, vpool, kblock, vblock, dst, scales=None,
+                      scale_row=None):
+    """Scatter one exported block's KV rows into pool block `dst`:
+    the transfer unit's WRITE half — a prefill replica's finished
+    prompt KV lands in a decode replica's pool through this one
+    compiled program (traced `dst`, donated destination pools, so the
+    handoff is an in-place HBM write, not a pool rebuild). Under int8
+    pools the block's `[layers, 2]` scale rows ride along into the
+    destination's scale array. The payload is bit-copied, never
+    re-quantized — decode over ingested blocks reads exactly the
+    bytes the prefill wrote, which is what makes disaggregated output
+    token-identical to a colocated engine. Raw jnp arrays in/out."""
+    kpool = jax.lax.dynamic_update_index_in_dim(kpool, kblock, dst,
+                                                axis=1)
+    vpool = jax.lax.dynamic_update_index_in_dim(vpool, vblock, dst,
+                                                axis=1)
+    if scales is None:
+        return kpool, vpool
+    scales = jax.lax.dynamic_update_index_in_dim(scales, scale_row,
+                                                 dst, axis=1)
     return kpool, vpool, scales
 
 

@@ -104,6 +104,7 @@ SUITE_ROWS = (
     "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill",
     "gpt_engine_speculative", "gpt_engine_offered_load_mp2",
     "gpt_engine_offered_load_int8", "gpt_fleet_offered_load",
+    "gpt_engine_multitenant_lora",
 )
 
 
@@ -208,6 +209,8 @@ def suite():
     cases["gpt_engine_offered_load_int8"] = _engine_offered_load_case(
         kv_dtype="int8")
     cases["gpt_fleet_offered_load"] = _fleet_offered_load_case()
+    cases["gpt_engine_multitenant_lora"] = \
+        _engine_multitenant_lora_case()
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -764,6 +767,171 @@ def _fleet_offered_load_case(model_cfg=None, num_tenants=3,
                 "requests": len(trace_cold) + len(trace_warm),
                 **{f"tokens_per_s_r{n}": results[n]["tokens_per_s"]
                    for n in replica_counts}}
+
+    return run_bench
+
+
+def _engine_multitenant_lora_case(model_cfg=None, num_tenants=4,
+                                  per_tenant=6, rank=8, max_rank=8,
+                                  prefix_len=48, suffix_max=24,
+                                  max_new=24, num_slots=8,
+                                  block_size=16, prefill_chunk=64,
+                                  adapter_pool_pages=None, seed=0):
+    """Multi-tenant batched-LoRA serving row (ISSUE 13): one base
+    model, `num_tenants` per-tenant adapters, a SKEWED trace (tenant t
+    carries `per_tenant >> t` requests, each a tenant system prompt +
+    fresh suffix) served two ways:
+
+    - MIXED (the subsystem under test): ONE engine with the full
+      adapter registry serves every tenant's requests interleaved —
+      the paged adapter pool gathers per-slot pages inside the one
+      compiled decode step, so the batch stays full across tenants.
+    - STRAWMAN: one dedicated engine per tenant (the pre-LoRA shape:
+      fork the engine per adapter), each serving only its own
+      requests, timed end to end sequentially — lanes idle whenever a
+      tenant has fewer live requests than slots.
+
+    The runner ASSERTS every request's output token-identical between
+    the two (the mixed-tenant exactness contract at bench scale) and
+    decode_traces == 1 on the mixed engine regardless of how many
+    adapters are live. Tracked numbers: mixed vs dedicated aggregate
+    tokens/s (+ the speedup), adapter-pool swap-ins/evictions, and
+    per-tenant p99 TTFT/TPOT off the adapter-labeled histograms —
+    the per-tenant SLO view only the mixed engine can even report."""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.adapters import AdapterRegistry
+        from paddle_tpu.inference import GenerationEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.observability.metrics import (
+            quantile_from_buckets, series_total,
+        )
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        reg = AdapterRegistry(cfg, max_rank=max_rank)
+        H, I, L = (cfg.hidden_size, cfg.intermediate_size,
+                   cfg.num_layers)
+        for t in range(num_tenants):
+            w = {}
+            for site, (i_d, o_d) in (("qkv", (H, 3 * H)),
+                                     ("out", (H, H)), ("fc1", (H, I)),
+                                     ("fc2", (I, H))):
+                w[site] = [
+                    (rng.randn(rank, i_d).astype(np.float32) * 0.05,
+                     rng.randn(o_d, rank).astype(np.float32) * 0.05)
+                    for _ in range(L)]
+            reg.register(t + 1, w, alpha=2 * rank)
+        tenants = [rng.randint(0, cfg.vocab_size, prefix_len)
+                   for _ in range(num_tenants)]
+        # skewed trace: tenant t carries per_tenant >> t requests
+        reqs = []
+        for t, pre in enumerate(tenants):
+            for _ in range(max(1, per_tenant >> t)):
+                sfx = rng.randint(0, cfg.vocab_size,
+                                  rng.randint(1, suffix_max + 1))
+                reqs.append((np.concatenate([pre, sfx]), t + 1,
+                             int(rng.randint(max(2, max_new // 2),
+                                             max_new + 1))))
+        order = rng.permutation(len(reqs))
+        # stable per-request ids so the mixed run and the per-tenant
+        # dedicated runs key the same request identically
+        reqs = [(f"r{i}", *reqs[j]) for i, j in enumerate(order)]
+
+        def build(adapters):
+            eng = GenerationEngine(
+                model, num_slots=num_slots, block_size=block_size,
+                prefill_chunk=prefill_chunk, adapters=adapters,
+                adapter_pool_pages=adapter_pool_pages
+                if adapters is not None else None)
+            if eng.kv_dtype is not None or eng.mp_degree != 1:
+                raise RuntimeError(
+                    "lora bench engine resolved kv_dtype="
+                    f"{eng.kv_dtype!r}/mp={eng.mp_degree} (is a "
+                    "PADDLE_SERVE_* env set?) — unset it to run this "
+                    "row")
+            # compile warmup off the record (chunk + decode programs)
+            eng.add_request(
+                rng.randint(0, cfg.vocab_size, prefill_chunk + 1),
+                max_new_tokens=2)
+            eng.run()
+            eng.metrics.reset()
+            return eng
+
+        def serve(eng, batch):
+            base = eng.tokens_generated
+            t0 = time.perf_counter()
+            ids = [eng.add_request(p, max_new_tokens=n, adapter_id=a,
+                                   req_id=rid)
+                   for rid, p, a, n in batch]
+            out = eng.run()
+            dt = time.perf_counter() - t0
+            return dt, eng.tokens_generated - base, \
+                {i: list(map(int, out[i])) for i in ids}, ids
+
+        mixed = build(reg)
+        dt_mix, toks_mix, out_mix, _ = serve(mixed, reqs)
+        assert mixed.decode_traces == 1, \
+            "mixed-tenant decode retraced — the adapter row must be " \
+            "traced, never a trace key"
+        snap = mixed.metrics_snapshot()
+        swapins = int(series_total(snap,
+                                   "engine_adapter_swapins_total"))
+        evictions = int(series_total(
+            snap, "engine_adapter_evictions_total"))
+
+        def tenant_pct(name, q):
+            fam = snap[name]
+            out = {}
+            for s in fam["series"]:
+                v = quantile_from_buckets(fam["buckets"], s["counts"],
+                                          q)
+                if v is not None:
+                    out[s["labels"]["adapter"]] = round(v * 1e3, 3)
+            return out
+
+        # strawman: per-tenant dedicated engines, timed sequentially
+        dt_ded, toks_ded, out_ded = 0.0, 0, {}
+        for t in range(num_tenants):
+            mine = [r for r in reqs if r[2] == t + 1]
+            if not mine:
+                continue
+            ded = build(reg)
+            dt, toks, outs, _ = serve(ded, mine)
+            dt_ded += dt
+            toks_ded += toks
+            out_ded.update(outs)
+        assert len(out_ded) == len(out_mix)
+        match = _token_match_fraction(
+            [out_mix[i] for i in sorted(out_mix, key=str)],
+            [out_ded[i] for i in sorted(out_ded, key=str)])
+        assert match == 1.0, \
+            (f"mixed-tenant outputs diverged from dedicated engines "
+             f"(match {match:.4f}) — cross-slot adapter leakage")
+        return {"tokens_per_s": round(toks_mix / dt_mix),
+                "tokens_per_s_dedicated": round(toks_ded / dt_ded),
+                "speedup_vs_dedicated": round(
+                    (toks_mix / dt_mix) / (toks_ded / dt_ded), 3),
+                "ms": round(dt_mix * 1e3, 1),
+                "tenants": num_tenants, "requests": len(reqs),
+                "rank": rank, "max_rank": max_rank,
+                "adapter_swapins": swapins,
+                "adapter_evictions": evictions,
+                "ttft_ms_p99_by_tenant": tenant_pct(
+                    "engine_adapter_ttft_seconds", 0.99),
+                "tpot_ms_p99_by_tenant": tenant_pct(
+                    "engine_adapter_tpot_seconds", 0.99),
+                "decode_recompiles": int(series_total(
+                    snap, "engine_decode_recompiles_total"))}
 
     return run_bench
 

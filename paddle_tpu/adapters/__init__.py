@@ -1,0 +1,27 @@
+"""Multi-tenant adapter serving: paged batched-LoRA over the
+generation engine.
+
+One base model, thousands of per-tenant tuned adapters — the canonical
+"millions of users" serving shape (S-LoRA / Punica). Three tiers:
+
+- `AdapterRegistry` (registry.py): host-side store of rank-padded
+  LoRA A/B factors per tenant (adapter id 0 = the null/base adapter);
+- `PagedAdapterPool` (pool.py): active adapters on-device, paged with
+  the PagedKVCache's block/refcount/LRU + stall-and-retry pattern,
+  host-side swap-in from the registry on miss
+  (`adapter_pool_spec` is the single layout truth);
+- `ops.lora`: the batched apply — per-slot A/B pages gathered by a
+  traced page row and fused into the qkv/out/fc1/fc2 matmuls with
+  fp32 accumulation, shape-stable in `max_rank`.
+
+The serving engine wires them together:
+`GenerationEngine(model, adapters=registry)` +
+`add_request(..., adapter_id=7)` — see README "Multi-tenant adapters".
+"""
+from paddle_tpu.adapters.pool import PagedAdapterPool, \
+    adapter_pool_spec
+from paddle_tpu.adapters.registry import NULL_ADAPTER_ID, \
+    AdapterRegistry
+
+__all__ = ["AdapterRegistry", "PagedAdapterPool", "adapter_pool_spec",
+           "NULL_ADAPTER_ID"]

@@ -1,0 +1,309 @@
+"""Paged on-device adapter pool — the DEVICE half of the multi-tenant
+adapter subsystem.
+
+The same shape the paged KV cache proved out, applied to adapter
+weights: a fixed number of device-resident PAGES per target site
+(`adapter_pool_spec` is the single layout truth), a host-side
+refcount per page, an LRU of refcount-zero (warm but idle) pages, and
+stall-and-retry under pressure — `acquire` returns None when every
+page is referenced, and the engine's scheduler retries next iteration
+exactly like a KV block stall. Page 0 is the NULL page: permanently
+held, all-zero factors, zero scaling — adapter id 0 resolves there and
+its delta is exactly zero.
+
+Swap-in is HOST-driven: on an `acquire` miss the pool copies the
+registry's rank-padded stacks onto a free (or LRU-evicted) page with
+one compiled `dynamic_update_index_in_dim` per site array (traced page
+index — one program per pool layout, donated so the write is in-place
+in HBM). The compiled engine steps only ever READ the pool arrays
+(they ride the steps as traced args beside the model state), so a
+swap-in between iterations never retraces anything.
+
+Under tensor parallel the B stacks shard their OUTPUT layout over the
+mesh's mp axis (`b_qkv` on the heads axis — the `_tp_plan` qkv
+grouping — and the linear sites on their column axis), while the A
+stacks and scalings replicate: each shard computes exactly its own
+slice of every delta with full-length dots, so batched LoRA at mp=N is
+bit-identical to mp=1 and adds NO collectives.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .registry import NULL_ADAPTER_ID, AdapterRegistry
+
+__all__ = ["PagedAdapterPool", "adapter_pool_spec"]
+
+
+def adapter_pool_spec(num_pages, num_layers, max_rank, hidden_size,
+                      intermediate_size, num_heads, dtype):
+    """The ONE source of truth for the pool's per-site array layout:
+    ordered {name: (shape, dtype, shard_axis)} where `shard_axis` is
+    the axis an mp mesh shards (None = replicated). Order is the
+    `ops.lora.LoraState` constructor order; the constructor, the
+    swap-in path, and the engine's shard_map in_specs all derive from
+    here, so the layouts cannot drift."""
+    P, L, R = int(num_pages), int(num_layers), int(max_rank)
+    H, I = int(hidden_size), int(intermediate_size)
+    heads = int(num_heads)
+    D = H // heads
+    return OrderedDict([
+        ("a_qkv", ((P, L, R, H), dtype, None)),
+        ("b_qkv", ((P, L, R, heads, 3, D), dtype, 3)),
+        ("a_out", ((P, L, R, H), dtype, None)),
+        ("b_out", ((P, L, R, H), dtype, 3)),
+        ("a_fc1", ((P, L, R, H), dtype, None)),
+        ("b_fc1", ((P, L, R, I), dtype, 3)),
+        ("a_fc2", ((P, L, R, I), dtype, None)),
+        ("b_fc2", ((P, L, R, H), dtype, 3)),
+        ("scaling", ((P,), np.float32, None)),
+    ])
+
+
+class PagedAdapterPool:
+    """Device-resident pages of active adapters + host-side paging.
+
+        reg = AdapterRegistry(model.config, max_rank=8)
+        pool = PagedAdapterPool(reg, num_pages=9)
+        page = pool.acquire(7)       # swap-in on miss; None = stall
+        ...
+        pool.release(7)              # refcount down; warm LRU at zero
+
+    `num_pages` INCLUDES the null page 0. The engine sizes the default
+    pool at `1 + num_slots` so a full batch of distinct tenants never
+    stalls; smaller pools trade HBM for swap-in traffic and ride the
+    stall/retry path under pressure."""
+
+    def __init__(self, registry, num_pages=None, dtype=None, mesh=None,
+                 mp_axis="mp", donate=None):
+        if not isinstance(registry, AdapterRegistry):
+            raise TypeError(
+                "PagedAdapterPool takes an AdapterRegistry (the "
+                "host-side store it swaps adapters in from)")
+        if num_pages is None:
+            num_pages = 1 + max(1, len(registry))
+        if num_pages < 2:
+            raise ValueError("need >= 2 adapter pages (page 0 is the "
+                             "null adapter)")
+        self.registry = registry
+        self.num_pages = int(num_pages)
+        self.max_rank = registry.max_rank
+        self.dtype = np.dtype(dtype) if dtype is not None \
+            else registry.dtype
+        self.mesh = mesh
+        self.mp_axis = mp_axis if mesh is not None else None
+        if mesh is not None:
+            mp = mesh.shape[mp_axis]
+            for name, dim in (("num_heads", registry.num_heads),
+                              ("hidden_size", registry.hidden_size),
+                              ("intermediate_size",
+                               registry.intermediate_size)):
+                if dim % mp:
+                    raise ValueError(
+                        f"{name}={dim} not divisible by mp degree "
+                        f"{mp} — cannot column-shard the adapter B "
+                        "pages")
+        self._spec = adapter_pool_spec(
+            self.num_pages, registry.num_layers, registry.max_rank,
+            registry.hidden_size, registry.intermediate_size,
+            registry.num_heads, self.dtype)
+        self._arrays = self._build_arrays()
+        self._updaters = None          # compiled swap-in, built lazily
+        if donate is None:
+            import jax
+
+            donate = jax.default_backend() != "cpu"
+        self._donate = bool(donate)
+        # paging state: the PagedKVCache story, page-sized
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._ref = [0] * self.num_pages
+        self._ref[0] = 1               # null page: permanently held
+        self._page_of = {}             # adapter id -> page
+        self._adapter_of = {}          # page -> adapter id
+        self._evictable = OrderedDict()    # page -> adapter id (LRU)
+        self.swapins = 0
+        self.evictions = 0
+        # the ONE engine this pool pages for (set at engine adoption):
+        # paging state is per-engine — refcounts/LRU/gauges interleaved
+        # across replicas would make one replica's drain audit see
+        # another's live references
+        self._owner = None
+
+    # -- layout -----------------------------------------------------------
+    def adapter_pool_spec(self):
+        """This pool's `adapter_pool_spec` layout table."""
+        return self._spec
+
+    def pool_pspecs(self):
+        """PartitionSpecs matching `arrays()` order, for the engine's
+        shard_map in_specs (all-empty without a mesh)."""
+        from jax.sharding import PartitionSpec
+
+        specs = []
+        for shape, _, axis in self._spec.values():
+            if self.mp_axis is None or axis is None:
+                specs.append(PartitionSpec())
+            else:
+                dims = [None] * len(shape)
+                dims[axis] = self.mp_axis
+                specs.append(PartitionSpec(*dims))
+        return tuple(specs)
+
+    def _build_arrays(self):
+        import jax
+        import jax.numpy as jnp
+
+        arrays = []
+        pspecs = self.pool_pspecs() if self.mesh is not None else None
+        for i, (shape, dt, _) in enumerate(self._spec.values()):
+            z = jnp.zeros(shape, dt)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+
+                z = jax.device_put(
+                    z, NamedSharding(self.mesh, pspecs[i]))
+            arrays.append(z)
+        return arrays
+
+    def arrays(self):
+        """The device pool arrays in `LoraState` order — the tuple the
+        engine threads through every compiled step."""
+        return tuple(self._arrays)
+
+    def pool_nbytes(self):
+        return sum(int(a.nbytes) for a in self._arrays)
+
+    # -- swap-in ----------------------------------------------------------
+    def _build_updaters(self):
+        import jax
+
+        updaters = []
+        pspecs = self.pool_pspecs()
+        for i, name in enumerate(self._spec):
+            def upd(pool, rows, page):
+                return jax.lax.dynamic_update_index_in_dim(
+                    pool, rows, page, axis=0)
+
+            upd.__name__ = f"adapter_swapin_{name}"
+            out_sh = None
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+
+                out_sh = NamedSharding(self.mesh, pspecs[i])
+            updaters.append(jax.jit(
+                upd, donate_argnums=(0,) if self._donate else (),
+                out_shardings=out_sh))
+        return updaters
+
+    def _write_page(self, page, stacks, scaling):
+        """Copy one adapter's host stacks onto `page` (traced index —
+        every swap-in of this pool reuses the same compiled copies)."""
+        import jax.numpy as jnp
+
+        if self._updaters is None:
+            self._updaters = self._build_updaters()
+        for i, name in enumerate(self._spec):
+            if name == "scaling":
+                rows = jnp.asarray(np.float32(scaling))
+            else:
+                shape, dt, _ = self._spec[name]
+                rows = jnp.asarray(np.asarray(stacks[name], dt))
+                if rows.shape != shape[1:]:
+                    raise ValueError(
+                        f"adapter stack {name} has shape {rows.shape},"
+                        f" pool page wants {shape[1:]}")
+            self._arrays[i] = self._updaters[i](
+                self._arrays[i], rows, jnp.int32(page))
+
+    # -- paging -----------------------------------------------------------
+    @property
+    def num_free(self):
+        """Pages acquirable right now: truly free + warm evictable."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def num_resident(self):
+        """Adapters currently materialized on a page (live + warm)."""
+        return len(self._page_of)
+
+    def refcount(self, page):
+        return self._ref[page]
+
+    def page_of(self, adapter_id):
+        """The page an adapter currently occupies (0 for the null
+        adapter, None when not resident)."""
+        aid = int(adapter_id)
+        if aid == NULL_ADAPTER_ID:
+            return 0
+        return self._page_of.get(aid)
+
+    def can_acquire(self, adapter_id):
+        """True when `acquire` would succeed right now (resident, or a
+        page is free/evictable) — the fleet's placement probe."""
+        aid = int(adapter_id)
+        return aid == NULL_ADAPTER_ID or aid in self._page_of \
+            or self.num_free > 0
+
+    def acquire(self, adapter_id):
+        """One reference on the adapter's page, swapping it in from
+        the registry on miss. Returns the page id, or None when every
+        page is referenced by a live lane (caller stalls/retries — the
+        KV allocator's contract). Unknown ids raise."""
+        aid = int(adapter_id)
+        if aid == NULL_ADAPTER_ID:
+            return 0
+        entry = self.registry.stacks(aid)      # raises when unknown
+        page = self._page_of.get(aid)
+        if page is not None:
+            if self._ref[page] == 0:
+                del self._evictable[page]      # revive: live again
+            self._ref[page] += 1
+            return page
+        if self._free:
+            page = self._free.pop()
+        elif self._evictable:
+            page, cold = self._evictable.popitem(last=False)
+            del self._page_of[cold]
+            del self._adapter_of[page]
+            self.evictions += 1
+        else:
+            return None                        # all pages referenced
+        self._write_page(page, entry, entry["scaling"])
+        self.swapins += 1
+        self._ref[page] = 1
+        self._page_of[aid] = page
+        self._adapter_of[page] = aid
+        return page
+
+    def release(self, adapter_id):
+        """Drop one reference; a page at refcount zero parks in the
+        warm LRU (still resident — the next acquire of the same tenant
+        is a hit) instead of being zeroed. Raises on over-release."""
+        aid = int(adapter_id)
+        if aid == NULL_ADAPTER_ID:
+            return
+        page = self._page_of.get(aid)
+        if page is None or self._ref[page] <= 0:
+            raise RuntimeError(
+                f"release of adapter {aid} with no live reference — a "
+                "scheduler path double-released an adapter page")
+        self._ref[page] -= 1
+        if self._ref[page] == 0:
+            self._evictable[page] = aid        # newest LRU entry
+
+    def leak_check(self):
+        """Page-accounting audit for a QUIESCED pool (no live lanes):
+        every non-null page must be on the free list or parked
+        refcount-zero in the warm LRU. Returns leaked page ids —
+        `GenerationEngine.drain()` asserts this empty, so a lane that
+        finished without releasing its adapter page fails as loudly as
+        a leaked KV block."""
+        free = set(self._free)
+        leaked = []
+        for p in range(1, self.num_pages):
+            if self._ref[p] == 0 and (p in free or p in self._evictable):
+                continue
+            leaked.append(p)
+        return leaked

@@ -47,16 +47,28 @@ MP_DEGREES = (1, 2)
 #: unbudgeted collective in the scale fold) fails the same gate.
 KV_DTYPES = (None, "int8")
 
+#: Multi-tenant LoRA configs (PR 13): the base matrix threads NO
+#: adapter state (its programs must stay byte-identical to the
+#: pre-adapter baseline), and these two extra configs prove the
+#: adapter-threaded steps — a plain fp mp=1 decode+prefill pass and
+#: the fully-composed (pallas, K=4, mp=2, int8) verify step — under
+#: every TPU1xx rule: donation still pins both pools, the lora
+#: einsums accumulate fp32 (TPU103), and the adapter gathers add NO
+#: collectives (TPU104's budget is unchanged).
+LORA_CONFIGS = (("dense", 0, 1, None, True),
+                ("pallas", 4, 2, "int8", True))
+
 #: Tiny-but-structurally-real harvest geometry: 2 layers so per-layer
 #: collective budgets multiply, 4 heads so mp=2 head-sharding divides,
 #: block_size 8 so the pallas kernel's sublane constraint holds.
 TINY = dict(vocab=64, hidden=32, layers=2, heads=4, seq=32,
-            slots=2, block_size=8)
+            slots=2, block_size=8, max_rank=4)
 
 
 def default_matrix():
-    return tuple((b, k, mp, kv) for b in BACKENDS for k in SPEC_KS
-                 for mp in MP_DEGREES for kv in KV_DTYPES)
+    return tuple((b, k, mp, kv, False) for b in BACKENDS
+                 for k in SPEC_KS for mp in MP_DEGREES
+                 for kv in KV_DTYPES) + LORA_CONFIGS
 
 
 def _require_devices(mp):
@@ -104,6 +116,25 @@ def _trace_one(name, config, pure_fn, jitted, args, mp, num_layers):
         arg_leaves=leaves)
 
 
+def _build_registry(config):
+    """A tiny one-adapter registry for the LoRA configs: shapes are
+    all abstract tracing sees, so the factors are zero-filled."""
+    import numpy as np
+
+    from paddle_tpu.adapters import AdapterRegistry
+
+    reg = AdapterRegistry(config, max_rank=TINY["max_rank"])
+    r, L = 2, config.num_layers
+    weights = {}
+    for site in ("qkv", "out", "fc1", "fc2"):
+        in_d, out_d = reg.site_dims(site)
+        weights[site] = [(np.zeros((r, in_d), np.float32),
+                          np.zeros((out_d, r), np.float32))
+                         for _ in range(L)]
+    reg.register(1, weights, scaling=0.5)
+    return reg
+
+
 def harvest(matrix=None):
     """-> list[TracedProgram] over the full contract matrix: one
     chunked engine per (backend, K, mp, kv_dtype) contributes its
@@ -112,15 +143,17 @@ def harvest(matrix=None):
     legacy bucketed prefill from a bucketed engine, COW block-copy)
     harvest once per (mp, kv_dtype) (12 more). The kv="int8" configs
     serve int8 per-block-scaled KV AND int8 weights — the full
-    quantized serving shape."""
+    quantized serving shape. The LORA_CONFIGS entries add the
+    adapter-threaded programs (4 more: a dense mp=1 decode + both
+    prefills, and the composed pallas/K=4/mp=2/int8 verify)."""
     import jax.numpy as jnp
     import numpy as np
 
     from paddle_tpu.inference.engine import GenerationEngine
 
     matrix = default_matrix() if matrix is None else tuple(
-        m if len(m) == 4 else (*m, None) for m in matrix)
-    for _, _, mp, _ in matrix:
+        (*m, None, False)[:5] if len(m) < 5 else m for m in matrix)
+    for _, _, mp, _, _ in matrix:
         _require_devices(mp)
     model = _build_model()
     L = model.config.num_layers
@@ -141,29 +174,39 @@ def harvest(matrix=None):
                 "harvest")
         return engine
 
-    for backend, K, mp, kv in matrix:
-        tag = ",int8" if kv else ""
+    registry = None
+    for backend, K, mp, kv, lora in matrix:
+        tag = (",int8" if kv else "") + (",lora" if lora else "")
         config = f"{backend},K={K},mp={mp}{tag}"
         quant = dict(kv_dtype=kv, weight_dtype=kv) if kv else {}
+        if lora and registry is None:
+            registry = _build_registry(model.config)
+        adapt = dict(adapters=registry) if lora else {}
         eng = check_knobs(GenerationEngine(
             model, num_slots=TINY["slots"],
             block_size=TINY["block_size"], attention_backend=backend,
-            spec_decode_k=K, mp_degree=mp, donate=True, **quant), kv)
+            spec_decode_k=K, mp_degree=mp, donate=True, **quant,
+            **adapt), kv)
         S, MB, C = eng.num_slots, eng.max_blocks, eng.prefill_chunk
         state = eng._state_arrays()
         kp, vp = eng.cache.kpool, eng.cache.vpool
         sc = (eng.cache.scales,) if kv else ()
+        # adapter serving: the pool-array tuple rides before the host
+        # args and the per-slot page row is the LAST host arg — the
+        # engine's _dispatch_step layout, reproduced exactly
+        lp = (eng.adapter_pool.arrays(),) if lora else ()
+        arow = (jnp.asarray(np.zeros(S, np.int32)),) if lora else ()
         tokens = jnp.asarray(np.zeros((S, K + 1), np.int32))
         positions = jnp.asarray(np.zeros(S, np.int32))
         tables = jnp.asarray(np.zeros((S, MB), np.int32))
         if K > 0:
             dlens = jnp.asarray(np.zeros(S, np.int32))
-            step_args = (state, kp, vp, *sc, tokens, positions, dlens,
-                         tables)
+            step_args = (state, kp, vp, *sc, *lp, tokens, positions,
+                         dlens, tables, *arow)
             step_name = "engine_verify_step"
         else:
-            step_args = (state, kp, vp, *sc, tokens, positions,
-                         tables)
+            step_args = (state, kp, vp, *sc, *lp, tokens, positions,
+                         tables, *arow)
             step_name = "engine_decode_step"
         programs.append(_trace_one(
             step_name, config, eng._decode_pure, eng._decode,
@@ -171,16 +214,20 @@ def harvest(matrix=None):
         # the prefill programs and the COW copy are backend- and
         # K-invariant today (paged_prefill_chunk has no backend seam;
         # the decode/verify steps are where the backends diverge), so
-        # they harvest ONCE per (mp, kv_dtype) — if a prefill backend
-        # ever grows, widen this to the full config string
+        # they harvest ONCE per (mp, kv_dtype, lora) — if a prefill
+        # backend ever grows, widen this to the full config string.
+        # The COW copy is adapter-oblivious, so the lora configs skip
+        # it (no duplicate baseline entry).
         if K == 0 and backend == "dense":
+            arow1 = (jnp.asarray(np.zeros(1, np.int32)),) if lora \
+                else ()
             chunk_tokens = jnp.asarray(np.zeros((1, C), np.int32))
             row = jnp.asarray(np.zeros(MB, np.int32))
             programs.append(_trace_one(
                 "engine_prefill_chunk", f"mp={mp}{tag}",
                 eng._prefill_pure, eng._prefill,
-                (state, kp, vp, *sc, chunk_tokens, jnp.int32(0),
-                 jnp.int32(TINY["block_size"] + 1), row),
+                (state, kp, vp, *sc, *lp, chunk_tokens, jnp.int32(0),
+                 jnp.int32(TINY["block_size"] + 1), row, *arow1),
                 mp, L))
             bucket = TINY["seq"] // 2
             beng = check_knobs(GenerationEngine(
@@ -188,25 +235,27 @@ def harvest(matrix=None):
                 block_size=TINY["block_size"],
                 attention_backend=backend,
                 prefill_buckets=(bucket, TINY["seq"]), mp_degree=mp,
-                donate=True, **quant), kv)
+                donate=True, **quant, **adapt), kv)
             btok = jnp.asarray(np.zeros((1, bucket), np.int32))
             # every arg from the BUCKETED engine itself — if its
             # geometry/state layout ever diverges from the chunked
             # engine's, the harvested signature must follow the real
             # program, not a lookalike
             bsc = (beng.cache.scales,) if kv else ()
+            blp = (beng.adapter_pool.arrays(),) if lora else ()
             brow = jnp.asarray(np.zeros(beng.max_blocks, np.int32))
             programs.append(_trace_one(
                 "engine_prefill", f"mp={mp}{tag}", beng._prefill_pure,
                 beng._prefill,
                 (beng._state_arrays(), beng.cache.kpool,
-                 beng.cache.vpool, *bsc, btok, jnp.int32(bucket - 2),
-                 brow),
+                 beng.cache.vpool, *bsc, *blp, btok,
+                 jnp.int32(bucket - 2), brow, *arow1),
                 mp, L))
-            cow_args = (kp, vp, jnp.int32(1), jnp.int32(2), *sc)
-            programs.append(_trace_one(
-                "engine_cow_copy", f"mp={mp}{tag}", eng._cow_pure,
-                eng._cow, cow_args, mp, L))
+            if not lora:
+                cow_args = (kp, vp, jnp.int32(1), jnp.int32(2), *sc)
+                programs.append(_trace_one(
+                    "engine_cow_copy", f"mp={mp}{tag}", eng._cow_pure,
+                    eng._cow, cow_args, mp, L))
     return programs
 
 

@@ -130,6 +130,25 @@ the unquantized engine; quantized output is tolerance-gated against
 the fp path (see README "Quantized serving"), token-exact across
 mesh shapes (per-block grids pmax-fold at mp>1) and across backends.
 
+Multi-tenant adapter serving (PR 13): one base model, thousands of
+per-tenant LoRA adapters — `GenerationEngine(adapters=registry)` wires
+the `paddle_tpu/adapters/` subsystem in: an `AdapterRegistry` holds
+rank-padded A/B factors host-side, a `PagedAdapterPool` pages active
+adapters on-device (the PagedKVCache block/refcount/LRU +
+stall-and-retry pattern, page-sized; host-side swap-in from the
+registry on miss), and every compiled step gains a traced `[slots]`
+adapter page row that gathers each lane's factors and fuses the
+low-rank delta `x·Aᵀ·Bᵀ·scaling` into the qkv/out/fc1/fc2 matmuls
+(`ops/lora.py`, fp32 accumulation) — shape-stable in `max_rank`, so
+`decode_traces == 1` holds for ANY tenant mix. Adapter id 0 is the
+null/base adapter (exact-zero delta); the prefix-cache chain hash is
+SALTED with the adapter id, so a base prompt's KV under one tenant can
+never alias another's, while id-0 reuse keys exactly as before.
+Composes with everything above: speculation verifies under the adapted
+model, mp>1 shards the B pages column-parallel (no new collectives,
+bit-identical across mesh shapes), and int8 KV/weights quantize the
+BASE path while adapters ride fp.
+
 Serving telemetry (PR 2): every engine carries a metrics registry
 (`engine.metrics`, observability tier) — TTFT/TPOT histograms, queue/
 slot/pool gauges with a high-water mark, admission/finish/stall
@@ -167,14 +186,21 @@ __all__ = ["PagedKVCache", "GenerationEngine", "Request",
            "PRIORITY_CLASSES", "prefix_key", "iter_prefix_key"]
 
 
-def iter_prefix_key(tokens, block_size):
+def iter_prefix_key(tokens, block_size, adapter_id=0):
     """Lazy form of `prefix_key`: yields the chain digests one full
     block at a time, so walkers that break at the first cache miss
     (`match_prefix`, `warm_prefix_tokens` on a cold cache) hash only
     as deep as they look."""
     tokens = np.asarray(tokens, np.int32)
     bs = int(block_size)
-    h = b""
+    # adapter-id SALT (multi-tenant LoRA serving): a tenant adapter
+    # changes the qkv projections, so the KV a prompt's prefill writes
+    # depends on the adapter — the same base prompt under two adapters
+    # must hash to DISJOINT chains or a cache hit would seat the wrong
+    # tenant's KV. Adapter 0 (the null/base adapter) salts with the
+    # empty seed, so base-model prefix reuse keys exactly as before.
+    h = b"" if not adapter_id else hashlib.blake2b(
+        b"adapter:%d" % int(adapter_id), digest_size=16).digest()
     for i in range(len(tokens) // bs):
         h = hashlib.blake2b(
             h + tokens[i * bs:(i + 1) * bs].tobytes(),
@@ -182,10 +208,12 @@ def iter_prefix_key(tokens, block_size):
         yield h
 
 
-def prefix_key(tokens, block_size):
+def prefix_key(tokens, block_size, adapter_id=0):
     """Chain digests over the FULL blocks of `tokens`: digest `i` is
-    blake2b(digest[i-1] ‖ block_i_tokens), so a digest names a block's
-    content AND its whole prefix — position/prefix-safe by
+    blake2b(digest[i-1] ‖ block_i_tokens), seeded with an adapter-id
+    salt (0 — the null/base adapter — seeds empty), so a digest names
+    a block's content AND its whole prefix AND the adapter whose
+    projections wrote its KV — position/prefix/tenant-safe by
     construction. Returns a tuple of 16-byte digests, one per full
     block (the ragged tail contributes nothing).
 
@@ -195,7 +223,7 @@ def prefix_key(tokens, block_size):
     (`inference.fleet.ServingFleet` steers a request to the replica
     whose cache owns the deepest digest of its prompt) — factored out
     so the two can never drift: a router key IS a cache key."""
-    return tuple(iter_prefix_key(tokens, block_size))
+    return tuple(iter_prefix_key(tokens, block_size, adapter_id))
 
 
 class PagedKVCache:
@@ -407,15 +435,16 @@ class PagedKVCache:
         refcount, or registered as cached prefix content."""
         return self._ref[block] > 1 or block in self._hash_of
 
-    def match_prefix(self, tokens):
-        """Longest cached block-aligned prefix of `tokens`: walks the
-        `prefix_key` chain digests over full blocks, takes a reference
-        on every hit (reviving evictable ones), and returns
-        (blocks, hit_tokens). Hit tokens never need recomputing —
-        their KV is already in the pool, byte-for-byte what this
-        prompt's prefill would write."""
+    def match_prefix(self, tokens, adapter_id=0):
+        """Longest cached block-aligned prefix of `tokens` under
+        `adapter_id`'s salted chain: walks the `prefix_key` digests
+        over full blocks, takes a reference on every hit (reviving
+        evictable ones), and returns (blocks, hit_tokens). Hit tokens
+        never need recomputing — their KV is already in the pool,
+        byte-for-byte what this (prompt, adapter)'s prefill would
+        write; a different adapter's chain can never alias it."""
         blocks = []
-        for h in iter_prefix_key(tokens, self.block_size):
+        for h in iter_prefix_key(tokens, self.block_size, adapter_id):
             b = self._block_of.get(h)
             if b is None:
                 break
@@ -425,7 +454,7 @@ class PagedKVCache:
             blocks.append(b)
         return blocks, len(blocks) * self.block_size
 
-    def warm_prefix_tokens(self, tokens, keys=None):
+    def warm_prefix_tokens(self, tokens, keys=None, adapter_id=0):
         """Prompt tokens a `match_prefix` would serve from this cache
         RIGHT NOW — a read-only peek (no references taken, evictable
         entries left parked) for the fleet router's affinity decision:
@@ -436,20 +465,22 @@ class PagedKVCache:
         once and reuse the digests."""
         hit = 0
         for h in (keys if keys is not None
-                  else iter_prefix_key(tokens, self.block_size)):
+                  else iter_prefix_key(tokens, self.block_size,
+                                       adapter_id)):
             if h not in self._block_of:
                 break
             hit += self.block_size
         return hit
 
-    def register_prefix(self, tokens, blocks):
+    def register_prefix(self, tokens, blocks, adapter_id=0):
         """Publish a fully-prefilled prompt's FULL blocks into the
-        prefix map (call only once every one of those blocks' KV rows
-        is written). First writer wins: a hash that is already mapped
-        keeps its original block and the racing copy stays private to
-        its slot. Returns the number of blocks newly cached."""
+        prefix map under `adapter_id`'s salted chain (call only once
+        every one of those blocks' KV rows is written). First writer
+        wins: a hash that is already mapped keeps its original block
+        and the racing copy stays private to its slot. Returns the
+        number of blocks newly cached."""
         added = 0
-        keys = iter_prefix_key(tokens, self.block_size)
+        keys = iter_prefix_key(tokens, self.block_size, adapter_id)
         for h, blk in zip(keys, blocks):
             b = int(blk)
             if h in self._block_of or b in self._hash_of:
@@ -500,6 +531,10 @@ class Request:
     # the engine's handoff buffer (take_handoff) instead of decoding —
     # the fleet moves those blocks into a decode replica's pool
     prefill_only: bool = False
+    # multi-tenant adapter serving: the tenant LoRA adapter this
+    # request decodes under (0 = the null/base adapter — the plain
+    # base model, bit-identical to a no-adapter engine)
+    adapter_id: int = 0
 
 
 @dataclass(eq=False)
@@ -515,6 +550,7 @@ class _Slot:
     prefill_pos: int = 0               # next prompt position to prefill
     hit_tokens: int = 0                # prefix-cache tokens never computed
     admit_seq: int = 0                 # admission order tiebreak
+    adapter_page: int = 0              # adapter-pool page (0 = null)
 
     @property
     def prefilling(self):
@@ -560,7 +596,8 @@ class GenerationEngine:
                  prefill_chunk="auto", enable_prefix_cache=None,
                  max_queue=None, spec_decode_k=0, drafter=None,
                  mesh=None, mp_degree=None, kv_dtype=None,
-                 weight_dtype=None):
+                 weight_dtype=None, adapters=None,
+                 adapter_pool_pages=None):
         from paddle_tpu.ops.paged_attention import (copy_pool_block,
                                                     resolve_backend)
 
@@ -631,6 +668,13 @@ class GenerationEngine:
             cfg.hidden_size // cfg.num_heads,
             dtype=model.gpt.wte.weight._array.dtype, mesh=self.mesh,
             kv_dtype=self.kv_dtype)
+        # multi-tenant adapter serving (paged batched-LoRA): an
+        # AdapterRegistry (or a prebuilt PagedAdapterPool) turns on
+        # per-slot adapter ids through every compiled step. None (the
+        # default) threads nothing — the engine's programs are
+        # BIT-identical to the pre-adapter ones.
+        self._resolve_adapters(adapters, adapter_pool_pages, cfg,
+                               model, donate)
         if self.chunked_prefill:
             self.prefill_buckets = ()
         else:
@@ -812,6 +856,87 @@ class GenerationEngine:
                 f"{env_name}/ctor value must be unset or 'int8', got "
                 f"{requested!r}")
         return "int8"
+
+    # -- multi-tenant adapter serving (paged batched-LoRA) -----------------
+    def _resolve_adapters(self, adapters, pages, cfg, model, donate):
+        """Wire the paged adapter pool: an AdapterRegistry builds a
+        pool on this engine's mesh (`adapter_pool_pages` pages,
+        default 1 + num_slots so a full batch of distinct tenants
+        never stalls); a prebuilt PagedAdapterPool is adopted after a
+        mesh/geometry check. None disables the subsystem entirely."""
+        if adapters is None:
+            if pages is not None:
+                raise ValueError(
+                    "adapter_pool_pages needs adapters= (a registry "
+                    "or pool) — pages of nothing would be a no-op")
+            self.adapter_pool = None
+            return
+        from paddle_tpu.adapters import AdapterRegistry, \
+            PagedAdapterPool
+
+        if isinstance(adapters, PagedAdapterPool):
+            if pages is not None:
+                raise ValueError("adapter_pool_pages conflicts with a "
+                                 "prebuilt PagedAdapterPool")
+            if adapters.mesh is not self.mesh:
+                raise ValueError(
+                    "the prebuilt adapter pool's mesh differs from "
+                    "the engine's — build it with the engine's mesh "
+                    "(or pass the registry and let the engine build "
+                    "the pool)")
+            if adapters._owner is not None \
+                    and adapters._owner is not self:
+                raise ValueError(
+                    "this PagedAdapterPool already pages for another "
+                    "engine — paging state (refcounts/LRU/gauges) is "
+                    "per-engine. Pass the AdapterRegistry instead and "
+                    "let each engine build its own pool (the registry "
+                    "is safely shared).")
+            pool, reg = adapters, adapters.registry
+        elif isinstance(adapters, AdapterRegistry):
+            reg = adapters
+            pool = PagedAdapterPool(
+                reg, num_pages=int(pages) if pages is not None
+                else 1 + self.num_slots,
+                dtype=model.gpt.wte.weight._array.dtype,
+                mesh=self.mesh, donate=donate)
+        else:
+            raise TypeError(
+                "adapters= takes an AdapterRegistry or a "
+                f"PagedAdapterPool, got {type(adapters).__name__}")
+        for name, want in (("num_layers", cfg.num_layers),
+                           ("hidden_size", cfg.hidden_size),
+                           ("intermediate_size", cfg.intermediate_size),
+                           ("num_heads", cfg.num_heads)):
+            if getattr(reg, name) != want:
+                raise ValueError(
+                    f"adapter registry {name}={getattr(reg, name)} "
+                    f"does not match the served model's {want}")
+        pool._owner = self
+        self.adapter_pool = pool
+
+    def _check_adapter(self, adapter_id):
+        """Validate an intake adapter id: 0 always passes (null/base);
+        anything else needs the adapter subsystem on and the id
+        registered."""
+        aid = int(adapter_id)
+        if aid == 0:
+            return 0
+        if self.adapter_pool is None:
+            raise ValueError(
+                f"adapter_id={aid} needs GenerationEngine("
+                "adapters=...) — this engine serves the base model "
+                "only")
+        if not self.adapter_pool.registry.has(aid):
+            raise ValueError(f"adapter {aid} is not registered")
+        return aid
+
+    def adapter_page_available(self, adapter_id):
+        """True when seating a request under `adapter_id` would not
+        stall on an adapter page right now — the fleet's placement
+        probe (mirrors `free_lanes` for KV headroom)."""
+        return self.adapter_pool is None or int(adapter_id) == 0 \
+            or self.adapter_pool.can_acquire(adapter_id)
 
     # -- int8 weight serving ----------------------------------------------
     def _weight_quant_plan(self):
@@ -998,10 +1123,17 @@ class GenerationEngine:
         # int8 KV: the replicated scale array rides between the pools
         # and the host args (inputs) and trails the pools (outputs)
         scales = (P(),) if self.kv_dtype == "int8" else ()
+        # adapters: the pool-array tuple rides before the host args
+        # (B pages output-sharded, A pages replicated) and the traced
+        # per-slot page row is one extra replicated host arg
+        lora = () if self.adapter_pool is None \
+            else (self.adapter_pool.pool_pspecs(),)
+        if lora:
+            n_repl += 1
         sharded = shard_map(
             fn, mesh=self.mesh,
             in_specs=(list(self._tp_specs), pool, pool) + scales
-            + (P(),) * n_repl,
+            + lora + (P(),) * n_repl,
             out_specs=(P(), pool, pool) + scales,
             # all-gathered logits/argmax are replicated by
             # construction; the static rep-checker can't prove it
@@ -1162,6 +1294,77 @@ class GenerationEngine:
             buckets=LATENCY_BUCKETS).labels(
                 backend=self.attention_backend)
         self._decode_traces_seen = 0
+        # multi-tenant adapter serving: per-TENANT latency series plus
+        # adapter-pool paging health. Registered only when the
+        # subsystem is on, so a plain engine's exposition is unchanged.
+        self._m_a_ttft = self._m_a_tpot = None
+        if self.adapter_pool is not None:
+            self._m_a_ttft = m.histogram(
+                "engine_adapter_ttft_seconds",
+                "Request arrival to first token, labeled by tenant "
+                "adapter id (0 = the null/base adapter) — the "
+                "per-tenant SLO view of engine_ttft_seconds.",
+                labelnames=("adapter",), buckets=LATENCY_BUCKETS)
+            self._m_a_tpot = m.histogram(
+                "engine_adapter_tpot_seconds",
+                "Per-output-token latency by tenant adapter id — the "
+                "per-tenant SLO view of engine_tpot_seconds.",
+                labelnames=("adapter",), buckets=LATENCY_BUCKETS)
+            self._m_a_pages = m.gauge(
+                "engine_adapter_pool_pages",
+                "Device-resident adapter pool pages (page 0 is the "
+                "permanently-held null adapter).")
+            self._m_a_pages.set(self.adapter_pool.num_pages)
+            self._m_a_used = m.gauge(
+                "engine_adapter_pool_used_pages",
+                "Adapter pages referenced by live lanes (warm "
+                "refcount-zero pages count as free capacity, like "
+                "evictable KV blocks).")
+            self._m_a_resident = m.gauge(
+                "engine_adapter_pool_resident",
+                "Adapters currently materialized on a page (live + "
+                "warm LRU).")
+            self._m_a_swapins = m.counter(
+                "engine_adapter_swapins_total",
+                "Host->device adapter page loads (an acquire missed "
+                "the pool and copied the registry's stacks in).")
+            self._m_a_evictions = m.counter(
+                "engine_adapter_evictions_total",
+                "Warm adapter pages evicted to make room for another "
+                "tenant (LRU, refcount-zero only).")
+            self._a_swapins_seen = self._a_evictions_seen = 0
+            self._update_adapter_gauges()
+
+    def _obs_ttft(self, req, v):
+        """Record one TTFT observation on the priority-labeled series
+        and (adapter serving) the tenant-labeled one."""
+        self._m_ttft.labels(priority=req.priority).observe(v)
+        if self._m_a_ttft is not None:
+            self._m_a_ttft.labels(
+                adapter=str(req.adapter_id)).observe(v)
+
+    def _obs_tpot(self, req, v):
+        self._m_tpot.labels(priority=req.priority).observe(v)
+        if self._m_a_tpot is not None:
+            self._m_a_tpot.labels(
+                adapter=str(req.adapter_id)).observe(v)
+
+    def _update_adapter_gauges(self):
+        pool = self.adapter_pool
+        if pool is None:
+            return
+        # re-set the static pages gauge too: a metrics.reset() (bench
+        # warmup, per-window scrapes) must not leave it at 0 forever
+        self._m_a_pages.set(pool.num_pages)
+        self._m_a_used.set(pool.num_pages - 1 - pool.num_free)
+        self._m_a_resident.set(pool.num_resident)
+        if pool.swapins > self._a_swapins_seen:
+            self._m_a_swapins.inc(pool.swapins - self._a_swapins_seen)
+            self._a_swapins_seen = pool.swapins
+        if pool.evictions > self._a_evictions_seen:
+            self._m_a_evictions.inc(
+                pool.evictions - self._a_evictions_seen)
+            self._a_evictions_seen = pool.evictions
 
     def _update_pool_gauges(self):
         # "used" = referenced blocks; refcount-zero cached blocks are
@@ -1197,43 +1400,40 @@ class GenerationEngine:
         out.append(self.max_model_len)
         return out
 
+    def _lora_args(self, rest):
+        """Unpack a compiled step's OPTIONAL adapter tail: with the
+        adapter subsystem on, the pool arrays ride as one tuple arg
+        right before the host args and the per-slot page row is the
+        LAST host arg. Returns (LoraState-or-None, remaining rest)."""
+        if self.adapter_pool is None:
+            return None, rest
+        from paddle_tpu.ops.lora import LoraState
+
+        return LoraState(rest[0], rest[-1]), rest[1:-1]
+
     def _build_decode(self):
         model, state = self.model, self._state
         backend = self.attention_backend
         mp_axis = self._mp_axis
+        use_q = self.kv_dtype == "int8"
 
-        if self.kv_dtype == "int8":
-            def decode_fn(state_arrays, kpool, vpool, scales, tokens,
-                          positions, tables):
-                arrays = self._materialize_state(state_arrays)
-                with bound_state(zip(state, arrays), state):
-                    h, kp, vp, sc = model.gpt.forward_decode_paged(
-                        Tensor._wrap(tokens), Tensor._wrap(positions),
-                        Tensor._wrap(kpool), Tensor._wrap(vpool),
-                        Tensor._wrap(tables), backend=backend,
-                        mp_axis=mp_axis,
-                        kv_scales=Tensor._wrap(scales))
-                    logits = model._logits_of(h, mp_axis=mp_axis)
-                    nxt = jnp.argmax(logits._array[:, 0], axis=-1) \
-                        .astype(jnp.int32)
-                    return nxt, kp._array, vp._array, sc._array
-
-            decode_fn.__name__ = "engine_decode_step"
-            return self._shard_steps(decode_fn, n_repl=3)
-
-        def decode_fn(state_arrays, kpool, vpool, tokens, positions,
-                      tables):
+        def decode_fn(state_arrays, kpool, vpool, *rest):
+            scales = rest[0] if use_q else None
+            lora, (tokens, positions, tables) = \
+                self._lora_args(rest[1:] if use_q else rest)
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
-                h, kp, vp = model.gpt.forward_decode_paged(
+                r = model.gpt.forward_decode_paged(
                     Tensor._wrap(tokens), Tensor._wrap(positions),
                     Tensor._wrap(kpool), Tensor._wrap(vpool),
                     Tensor._wrap(tables), backend=backend,
-                    mp_axis=mp_axis)
-                logits = model._logits_of(h, mp_axis=mp_axis)
+                    mp_axis=mp_axis,
+                    kv_scales=None if scales is None
+                    else Tensor._wrap(scales), lora=lora)
+                logits = model._logits_of(r[0], mp_axis=mp_axis)
                 nxt = jnp.argmax(logits._array[:, 0], axis=-1) \
                     .astype(jnp.int32)                # logits [slots,1,V]
-                return nxt, kp._array, vp._array
+                return (nxt,) + tuple(t._array for t in r[1:])
 
         decode_fn.__name__ = "engine_decode_step"
         return self._shard_steps(decode_fn, n_repl=3)
@@ -1246,39 +1446,25 @@ class GenerationEngine:
         model, state = self.model, self._state
         backend = self.attention_backend
         mp_axis = self._mp_axis
+        use_q = self.kv_dtype == "int8"
 
-        if self.kv_dtype == "int8":
-            def verify_fn(state_arrays, kpool, vpool, scales, tokens,
-                          positions, dlens, tables):
-                arrays = self._materialize_state(state_arrays)
-                with bound_state(zip(state, arrays), state):
-                    h, kp, vp, sc = model.gpt.forward_verify_paged(
-                        Tensor._wrap(tokens), Tensor._wrap(positions),
-                        Tensor._wrap(dlens), Tensor._wrap(kpool),
-                        Tensor._wrap(vpool), Tensor._wrap(tables),
-                        backend=backend, mp_axis=mp_axis,
-                        kv_scales=Tensor._wrap(scales))
-                    logits = model._logits_of(h, mp_axis=mp_axis)
-                    nxt = jnp.argmax(logits._array, axis=-1) \
-                        .astype(jnp.int32)
-                    return nxt, kp._array, vp._array, sc._array
-
-            verify_fn.__name__ = "engine_verify_step"
-            return self._shard_steps(verify_fn, n_repl=4)
-
-        def verify_fn(state_arrays, kpool, vpool, tokens, positions,
-                      dlens, tables):
+        def verify_fn(state_arrays, kpool, vpool, *rest):
+            scales = rest[0] if use_q else None
+            lora, (tokens, positions, dlens, tables) = \
+                self._lora_args(rest[1:] if use_q else rest)
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
-                h, kp, vp = model.gpt.forward_verify_paged(
+                r = model.gpt.forward_verify_paged(
                     Tensor._wrap(tokens), Tensor._wrap(positions),
                     Tensor._wrap(dlens), Tensor._wrap(kpool),
                     Tensor._wrap(vpool), Tensor._wrap(tables),
-                    backend=backend, mp_axis=mp_axis)
-                logits = model._logits_of(h, mp_axis=mp_axis)
+                    backend=backend, mp_axis=mp_axis,
+                    kv_scales=None if scales is None
+                    else Tensor._wrap(scales), lora=lora)
+                logits = model._logits_of(r[0], mp_axis=mp_axis)
                 nxt = jnp.argmax(logits._array, axis=-1) \
                     .astype(jnp.int32)           # logits [slots,K+1,V]
-                return nxt, kp._array, vp._array
+                return (nxt,) + tuple(t._array for t in r[1:])
 
         verify_fn.__name__ = "engine_verify_step"
         return self._shard_steps(verify_fn, n_repl=4)
@@ -1288,42 +1474,22 @@ class GenerationEngine:
 
         model, state = self.model, self._state
         mp_axis = self._mp_axis
+        use_q = self.kv_dtype == "int8"
 
-        if self.kv_dtype == "int8":
-            def prefill_fn(state_arrays, kpool, vpool, scales, tokens,
-                           plen, table_row):
-                arrays = self._materialize_state(state_arrays)
-                with bound_state(zip(state, arrays), state):
-                    hidden, ks, vs = model.gpt.forward_prefill(
-                        Tensor._wrap(tokens), mp_axis=mp_axis)
-                    kp, vp, sc = paged_prefill_write(
-                        Tensor._wrap(kpool), Tensor._wrap(vpool), ks,
-                        vs, Tensor._wrap(table_row),
-                        Tensor._wrap(plen),
-                        scales=Tensor._wrap(scales), mp_axis=mp_axis)
-                    sel = (jnp.arange(tokens.shape[1]) == plen - 1) \
-                        .astype(hidden._array.dtype)
-                    h_last = (hidden._array * sel[None, :, None]) \
-                        .sum(axis=1, keepdims=True)
-                    logits = model._logits_of(Tensor._wrap(h_last),
-                                              mp_axis=mp_axis)
-                    nxt = jnp.argmax(logits._array[0, 0]) \
-                        .astype(jnp.int32)
-                    return nxt, kp._array, vp._array, sc._array
-
-            prefill_fn.__name__ = "engine_prefill"
-            return self._shard_steps(prefill_fn, n_repl=3)
-
-        def prefill_fn(state_arrays, kpool, vpool, tokens, plen,
-                       table_row):
+        def prefill_fn(state_arrays, kpool, vpool, *rest):
             # tokens [1, bucket]; plen traced -> one program per bucket
+            scales = rest[0] if use_q else None
+            lora, (tokens, plen, table_row) = \
+                self._lora_args(rest[1:] if use_q else rest)
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
                 hidden, ks, vs = model.gpt.forward_prefill(
-                    Tensor._wrap(tokens), mp_axis=mp_axis)
-                kp, vp = paged_prefill_write(
+                    Tensor._wrap(tokens), mp_axis=mp_axis, lora=lora)
+                w = paged_prefill_write(
                     Tensor._wrap(kpool), Tensor._wrap(vpool), ks, vs,
-                    Tensor._wrap(table_row), Tensor._wrap(plen))
+                    Tensor._wrap(table_row), Tensor._wrap(plen),
+                    scales=None if scales is None
+                    else Tensor._wrap(scales), mp_axis=mp_axis)
                 # only the last REAL position's logits matter: one-hot
                 # reduce to [1,1,H] before the vocab matmul
                 sel = (jnp.arange(tokens.shape[1]) == plen - 1) \
@@ -1333,7 +1499,7 @@ class GenerationEngine:
                 logits = model._logits_of(Tensor._wrap(h_last),
                                           mp_axis=mp_axis)
                 nxt = jnp.argmax(logits._array[0, 0]).astype(jnp.int32)
-                return nxt, kp._array, vp._array
+                return (nxt,) + tuple(t._array for t in w)
 
         prefill_fn.__name__ = "engine_prefill"
         return self._shard_steps(prefill_fn, n_repl=3)
@@ -1342,54 +1508,35 @@ class GenerationEngine:
         model, state = self.model, self._state
         C = self.prefill_chunk
         mp_axis = self._mp_axis
+        use_q = self.kv_dtype == "int8"
 
-        if self.kv_dtype == "int8":
-            def prefill_chunk_fn(state_arrays, kpool, vpool, scales,
-                                 tokens, start, plen, table_row):
-                arrays = self._materialize_state(state_arrays)
-                with bound_state(zip(state, arrays), state):
-                    hidden, kp, vp, sc = model.gpt.forward_prefill_chunk(
-                        Tensor._wrap(tokens), Tensor._wrap(start),
-                        Tensor._wrap(kpool), Tensor._wrap(vpool),
-                        Tensor._wrap(table_row), Tensor._wrap(plen),
-                        mp_axis=mp_axis,
-                        kv_scales=Tensor._wrap(scales))
-                    sel = (start + jnp.arange(C) == plen - 1) \
-                        .astype(hidden._array.dtype)
-                    h_last = (hidden._array * sel[None, :, None]) \
-                        .sum(axis=1, keepdims=True)
-                    logits = model._logits_of(Tensor._wrap(h_last),
-                                              mp_axis=mp_axis)
-                    nxt = jnp.argmax(logits._array[0, 0]) \
-                        .astype(jnp.int32)
-                    return nxt, kp._array, vp._array, sc._array
-
-            prefill_chunk_fn.__name__ = "engine_prefill_chunk"
-            return self._shard_steps(prefill_chunk_fn, n_repl=4)
-
-        def prefill_chunk_fn(state_arrays, kpool, vpool, tokens, start,
-                             plen, table_row):
+        def prefill_chunk_fn(state_arrays, kpool, vpool, *rest):
             # tokens [1, C] FIXED; start/plen traced -> ONE program
             # serves every chunk of every prompt length
+            scales = rest[0] if use_q else None
+            lora, (tokens, start, plen, table_row) = \
+                self._lora_args(rest[1:] if use_q else rest)
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
-                hidden, kp, vp = model.gpt.forward_prefill_chunk(
+                r = model.gpt.forward_prefill_chunk(
                     Tensor._wrap(tokens), Tensor._wrap(start),
                     Tensor._wrap(kpool), Tensor._wrap(vpool),
                     Tensor._wrap(table_row), Tensor._wrap(plen),
-                    mp_axis=mp_axis)
+                    mp_axis=mp_axis,
+                    kv_scales=None if scales is None
+                    else Tensor._wrap(scales), lora=lora)
                 # the LAST REAL prompt position's logits yield the
                 # first generated token; it lives in the final chunk —
                 # for earlier chunks the one-hot selects nothing and
                 # the host ignores the returned token
                 sel = (start + jnp.arange(C) == plen - 1) \
-                    .astype(hidden._array.dtype)
-                h_last = (hidden._array * sel[None, :, None]) \
+                    .astype(r[0]._array.dtype)
+                h_last = (r[0]._array * sel[None, :, None]) \
                     .sum(axis=1, keepdims=True)
                 logits = model._logits_of(Tensor._wrap(h_last),
                                           mp_axis=mp_axis)
                 nxt = jnp.argmax(logits._array[0, 0]).astype(jnp.int32)
-                return nxt, kp._array, vp._array
+                return (nxt,) + tuple(t._array for t in r[1:])
 
         prefill_chunk_fn.__name__ = "engine_prefill_chunk"
         return self._shard_steps(prefill_chunk_fn, n_repl=4)
@@ -1443,7 +1590,7 @@ class GenerationEngine:
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
                     req_id=None, priority="standard",
-                    prefill_only=False):
+                    prefill_only=False, adapter_id=0):
         """Queue a request; admitted into a free slot between decode
         iterations (may be called while `run`/`step` is mid-stream).
         `priority` is one of PRIORITY_CLASSES — higher classes admit
@@ -1457,18 +1604,24 @@ class GenerationEngine:
         engine prefills the prompt, emits the FIRST token, then parks
         the prompt's KV blocks for `take_handoff` instead of decoding
         further (`max_new_tokens` must be 1 — the fleet's decode
-        replica owns the rest of the budget)."""
+        replica owns the rest of the budget).
+
+        `adapter_id` selects the tenant LoRA adapter the request
+        decodes under (needs `GenerationEngine(adapters=...)`; 0 — the
+        default — is the null/base adapter and always valid)."""
         if prefill_only and max_new_tokens != 1:
             raise ValueError(
                 "prefill_only requests carry max_new_tokens=1 (the "
                 "single token the final prefill chunk yields); the "
                 "decode replica owns the remaining budget")
+        adapter_id = self._check_adapter(adapter_id)
         prompt, req_id = self._intake_guard(prompt, max_new_tokens,
                                             priority, req_id)
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         req = Request(req_id, prompt, int(max_new_tokens), eos,
                       arrived_at=time.perf_counter(), priority=priority,
-                      prefill_only=bool(prefill_only))
+                      prefill_only=bool(prefill_only),
+                      adapter_id=adapter_id)
         if self.max_queue is not None \
                 and self.num_pending >= self.max_queue:
             victim = self._shed_victim(priority)
@@ -1516,16 +1669,21 @@ class GenerationEngine:
 
     def _dispatch_step(self, jitted, *host_args):
         """Invoke a compiled step: state + pools (+ the int8 scale
-        array) threaded in, updated pools (+ scales) re-seated on the
-        cache, the leading token output returned."""
+        array) (+ the adapter-pool arrays) threaded in, updated pools
+        (+ scales) re-seated on the cache, the leading token output
+        returned. With adapters on, the caller appends the per-slot
+        adapter page row as the LAST host arg."""
         c = self.cache
+        args = [self._state_arrays(), c.kpool, c.vpool]
         if c.scales is not None:
-            nxt, c.kpool, c.vpool, c.scales = jitted(
-                self._state_arrays(), c.kpool, c.vpool, c.scales,
-                *host_args)
+            args.append(c.scales)
+        if self.adapter_pool is not None:
+            args.append(self.adapter_pool.arrays())
+        out = jitted(*args, *host_args)
+        if c.scales is not None:
+            nxt, c.kpool, c.vpool, c.scales = out
         else:
-            nxt, c.kpool, c.vpool = jitted(
-                self._state_arrays(), c.kpool, c.vpool, *host_args)
+            nxt, c.kpool, c.vpool = out
         return nxt
 
     def _in_flight(self):
@@ -1553,11 +1711,19 @@ class GenerationEngine:
             self._queues[req.priority].popleft()
         return req
 
+    def _release_adapter(self, slot):
+        """Return a vacating lane's adapter-page reference (refcount
+        down; the page parks warm in the pool's LRU at zero)."""
+        if self.adapter_pool is not None and slot.req.adapter_id:
+            self.adapter_pool.release(slot.req.adapter_id)
+            self._update_adapter_gauges()
+
     def _finish(self, slot, reason):
         req = slot.req
         self._results[req.req_id] = \
             list(map(int, req.prompt)) + slot.generated
         self.cache.free(slot.blocks)
+        self._release_adapter(slot)
         self._m_finished.labels(reason=reason).inc()
 
     def _first_token(self, slot, first, t_step):
@@ -1573,12 +1739,14 @@ class GenerationEngine:
         self.tokens_generated += 1
         self._m_tokens.inc()
         if req.arrived_at is not None:
-            self._m_ttft.labels(priority=req.priority).observe(
-                now - req.arrived_at)
+            self._obs_ttft(req, now - req.arrived_at)
         if self.enable_prefix_cache:
             # the prompt's KV is now fully written: publish its FULL
-            # blocks for future admissions to seat read-only
-            self.cache.register_prefix(req.prompt, slot.blocks)
+            # blocks for future admissions to seat read-only (under
+            # the request's adapter-salted chain — a tenant's KV can
+            # only ever hit the same tenant)
+            self.cache.register_prefix(req.prompt, slot.blocks,
+                                       adapter_id=req.adapter_id)
         done_eos = (req.eos_token_id is not None
                     and first == req.eos_token_id)
         if done_eos or req.max_new_tokens == 1:
@@ -1586,8 +1754,7 @@ class GenerationEngine:
             # invisible to the TPOT histogram while still counting in
             # engine_tokens_generated_total — record the producing
             # step's latency explicitly
-            self._m_tpot.labels(priority=req.priority).observe(
-                now - t_step)
+            self._obs_tpot(req, now - t_step)
             if req.prefill_only:
                 self._handoff_finish(slot)
             else:
@@ -1609,6 +1776,10 @@ class GenerationEngine:
                                       slot.hit_tokens)
         self._results[req.req_id] = \
             list(map(int, req.prompt)) + slot.generated
+        # the adapter page is NOT parked with the blocks: its job
+        # (prefill under the tenant's projections) is done, and the
+        # decode replica acquires from its OWN pool at adoption
+        self._release_adapter(slot)
         self._m_finished.labels(reason="handoff").inc()
 
     # -- admission: chunked (default) --------------------------------------
@@ -1624,14 +1795,24 @@ class GenerationEngine:
             req = self._pop_request()
             if req is None:
                 break
+            page = self._acquire_adapter(req)
+            if page is None:
+                # adapter-pool pressure: every page is referenced by a
+                # live lane. Requeue at the FRONT (strict order kept)
+                # and retry when a lane vacates — the KV stall/retry
+                # contract, page-sized.
+                self._queues[req.priority].appendleft(req)
+                break
             blocks, hit = [], 0
             if self.enable_prefix_cache:
-                blocks, hit = self.cache.match_prefix(req.prompt)
+                blocks, hit = self.cache.match_prefix(
+                    req.prompt, adapter_id=req.adapter_id)
                 if hit:
                     self.prefix_hit_tokens += hit
                     self._m_hit_tokens.inc(hit)
             slot = _Slot(req=req, blocks=list(blocks), prefill_pos=hit,
-                         hit_tokens=hit, admit_seq=self._admit_counter)
+                         hit_tokens=hit, admit_seq=self._admit_counter,
+                         adapter_page=page)
             self._admit_counter += 1
             self._slots[self._slots.index(None)] = slot
             self._m_admissions.inc()
@@ -1639,6 +1820,21 @@ class GenerationEngine:
             admitted += 1
         self._m_queue.set(self.num_pending)
         return admitted
+
+    def _acquire_adapter(self, req):
+        """Take the adapter-page reference a request's lane needs (the
+        null adapter is page 0, never paged). Returns the page, or
+        None on adapter-pool pressure (stall counted; caller requeues
+        and retries — admission's analog of a KV block stall)."""
+        if self.adapter_pool is None or not req.adapter_id:
+            return 0
+        page = self.adapter_pool.acquire(req.adapter_id)
+        if page is None:
+            self._m_stalls.labels(path="adapter",
+                                  shard=self._shard).inc()
+            return None
+        self._update_adapter_gauges()
+        return page
 
     def _prefill_step(self):
         """Run at most ONE compiled prefill chunk: pick the neediest
@@ -1671,12 +1867,15 @@ class GenerationEngine:
             tokens[0, :end - start] = req.prompt[start:end]
             row = np.zeros(self.max_blocks, np.int32)
             row[:len(slot.blocks)] = slot.blocks
+            args = [jnp.asarray(tokens), jnp.int32(start),
+                    jnp.int32(plen), jnp.asarray(row)]
+            if self.adapter_pool is not None:
+                # the chunk serves ONE slot: its adapter page, [1]-row
+                args.append(jnp.asarray(
+                    np.asarray([slot.adapter_page], np.int32)))
             with RecordEvent("engine.prefill"):
                 t0 = time.perf_counter()
-                nxt = self._dispatch_step(
-                    self._prefill, jnp.asarray(tokens),
-                    jnp.int32(start), jnp.int32(plen),
-                    jnp.asarray(row))
+                nxt = self._dispatch_step(self._prefill, *args)
                 self._m_prefill_chunks.inc()
                 slot.prefill_pos = end
                 if end < plen:         # mid-prompt: no sync needed
@@ -1703,6 +1902,14 @@ class GenerationEngine:
                 self._m_stalls.labels(path="admit", shard=self._shard).inc()
                 break                      # pool pressure: retry later
             self._update_pool_gauges()     # high-water sees the peak
+            # adapter page AFTER the blocks: a block stall must not
+            # have burned a swap-in (or evicted another tenant's warm
+            # page) for an admission that cannot seat anyway
+            page = self._acquire_adapter(req)
+            if page is None:
+                self.cache.free(blocks)    # fresh, unhashed -> free list
+                self._update_pool_gauges()
+                break                  # adapter pressure: retry later
             self._pop_request()
             bucket = self._bucket_for(plen)
             tokens = np.zeros((1, bucket), np.int32)
@@ -1710,16 +1917,20 @@ class GenerationEngine:
             row = np.zeros(self.max_blocks, np.int32)
             row[:need] = blocks
             slot = _Slot(req=req, blocks=blocks, prefill_pos=plen,
-                         admit_seq=self._admit_counter)
+                         admit_seq=self._admit_counter,
+                         adapter_page=page)
             self._admit_counter += 1
             self._slots[self._slots.index(None)] = slot
             self._m_admissions.inc()
             admitted += 1
+            args = [jnp.asarray(tokens), jnp.int32(plen),
+                    jnp.asarray(row)]
+            if self.adapter_pool is not None:
+                args.append(jnp.asarray(
+                    np.asarray([slot.adapter_page], np.int32)))
             with RecordEvent("engine.prefill"):
                 t0 = time.perf_counter()
-                first = self._dispatch_step(
-                    self._prefill, jnp.asarray(tokens),
-                    jnp.int32(plen), jnp.asarray(row))
+                first = self._dispatch_step(self._prefill, *args)
                 first = int(first)         # sync: first token is out
             self._first_token(slot, first, t0)
         self._m_queue.set(self.num_pending)
@@ -1794,16 +2005,22 @@ class GenerationEngine:
         positions = np.zeros(self.num_slots, np.int32)
         tables = np.zeros((self.num_slots, self.max_blocks),
                           np.int32)
+        arows = np.zeros(self.num_slots, np.int32)
         for i in runnable:
             slot = self._slots[i]
             tokens[i, 0] = slot.feed_token
             positions[i] = slot.feed_pos
             tables[i, :len(slot.blocks)] = slot.blocks
+            arows[i] = slot.adapter_page
+        args = [jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables)]
+        if self.adapter_pool is not None:
+            # per-slot adapter page row (idle/stalled lanes ride the
+            # null page 0 — exact-zero delta, like the null block)
+            args.append(jnp.asarray(arows))
         with RecordEvent("engine.decode"):
             t_dec = time.perf_counter()
-            nxt = self._dispatch_step(
-                self._decode, jnp.asarray(tokens),
-                jnp.asarray(positions), jnp.asarray(tables))
+            nxt = self._dispatch_step(self._decode, *args)
             nxt = np.asarray(nxt)      # sync: tokens are out
             self._m_decode_seconds.observe(
                 time.perf_counter() - t_dec)
@@ -1820,14 +2037,12 @@ class GenerationEngine:
                 # this decode produced the request's FIRST token (its
                 # whole prompt came from the prefix cache)
                 if req.arrived_at is not None:
-                    self._m_ttft.labels(priority=req.priority).observe(
-                        now - req.arrived_at)
+                    self._obs_ttft(req, now - req.arrived_at)
             elif slot.last_token_at is not None:
                 # inter-token latency per SLOT, not this iteration's
                 # wall time: a lane that sat out N stalled iterations
                 # reports the (N+1)-iteration gap its user experienced
-                self._m_tpot.labels(priority=req.priority).observe(
-                    now - slot.last_token_at)
+                self._obs_tpot(req, now - slot.last_token_at)
             slot.last_token_at = now
             done_eos = req.eos_token_id is not None \
                 and tok == req.eos_token_id
@@ -1835,8 +2050,7 @@ class GenerationEngine:
                 if is_first:
                     # single-token request: its only token still lands
                     # in the TPOT histogram (producing-step latency)
-                    self._m_tpot.labels(
-                        priority=req.priority).observe(now - t_dec)
+                    self._obs_tpot(req, now - t_dec)
                 if req.prefill_only:
                     # full-prefix-hit prefill-only lane: its one decode
                     # step produced the first token — park the blocks
@@ -1948,6 +2162,7 @@ class GenerationEngine:
         positions = np.zeros(self.num_slots, np.int32)
         dlens = np.zeros(self.num_slots, np.int32)
         tables = np.zeros((self.num_slots, self.max_blocks), np.int32)
+        arows = np.zeros(self.num_slots, np.int32)
         for i in runnable:
             slot = self._slots[i]
             d = drafts[i]
@@ -1957,12 +2172,14 @@ class GenerationEngine:
             positions[i] = slot.feed_pos
             dlens[i] = len(d)
             tables[i, :len(slot.blocks)] = slot.blocks
+            arows[i] = slot.adapter_page
+        args = [jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(dlens), jnp.asarray(tables)]
+        if self.adapter_pool is not None:
+            args.append(jnp.asarray(arows))
         with RecordEvent("engine.decode"):
             t_dec = time.perf_counter()
-            nxt = self._dispatch_step(
-                self._decode, jnp.asarray(tokens),
-                jnp.asarray(positions), jnp.asarray(dlens),
-                jnp.asarray(tables))
+            nxt = self._dispatch_step(self._decode, *args)
             nxt = np.asarray(nxt)      # sync: [slots, K+1] argmaxes
             self._m_decode_seconds.observe(
                 time.perf_counter() - t_dec)
@@ -2002,8 +2219,7 @@ class GenerationEngine:
                 self._m_spec_hit_rate.set(
                     self._m_spec_ok.value / proposed)
             if is_first and req.arrived_at is not None:
-                self._m_ttft.labels(priority=req.priority).observe(
-                    now - req.arrived_at)
+                self._obs_ttft(req, now - req.arrived_at)
             # multi-token latency accounting: every accepted token is
             # recorded against its producing step — the lane's step
             # gap amortized per token, so TPOT sums still integrate
@@ -2012,8 +2228,7 @@ class GenerationEngine:
                          else slot.last_token_at)
             n_tpot = m_tok - 1 if is_first else m_tok
             for _ in range(n_tpot):
-                self._m_tpot.labels(priority=req.priority).observe(
-                    gap / m_tok)
+                self._obs_tpot(req, gap / m_tok)
             slot.last_token_at = now
             done_eos = req.eos_token_id is not None \
                 and emit[-1] == req.eos_token_id
@@ -2021,8 +2236,7 @@ class GenerationEngine:
                 if is_first and n_tpot == 0:
                     # single-token instant finisher: keep it visible
                     # (the PR-6 TPOT contract)
-                    self._m_tpot.labels(
-                        priority=req.priority).observe(now - t_dec)
+                    self._obs_tpot(req, now - t_dec)
                 if req.prefill_only:
                     self._handoff_finish(slot)
                 else:
@@ -2050,6 +2264,7 @@ class GenerationEngine:
         self._m_active.set(self.num_active)
         self._m_queue.set(self.num_pending)
         self._update_pool_gauges()
+        self._update_adapter_gauges()
         self._sample_traces()
 
     @property
@@ -2096,7 +2311,8 @@ class GenerationEngine:
 
     def adopt_request(self, prompt, first_token, blocks,
                       max_new_tokens, eos_token_id=None, req_id=None,
-                      priority="standard", arrived_at=None):
+                      priority="standard", arrived_at=None,
+                      adapter_id=0):
         """Seat a request whose prompt KV is ALREADY in this engine's
         pool — the decode-side intake of disaggregated serving. The
         fleet allocates `blocks` from this engine's cache, ingests the
@@ -2109,7 +2325,12 @@ class GenerationEngine:
         Raises when no lane is free (check `free_lanes` first) — the
         fleet, not the engine, owns handoff queueing. The first token
         is not re-counted in `tokens_generated` (its producing replica
-        already counted it)."""
+        already counted it). `adapter_id` is the tenant adapter the
+        request decodes under — the page comes from THIS engine's
+        adapter pool (the prefill replica's page never travels); the
+        fleet probes `adapter_page_available` before placing, so an
+        unavailable page here is a caller bug and raises."""
+        adapter_id = self._check_adapter(adapter_id)
         prompt, req_id = self._intake_guard(prompt, max_new_tokens,
                                             priority, req_id)
         need = math.ceil(prompt.size / self.block_size)
@@ -2124,12 +2345,19 @@ class GenerationEngine:
         eos = self.eos_token_id if eos_token_id is None \
             else eos_token_id
         req = Request(req_id, prompt, int(max_new_tokens), eos,
-                      arrived_at=arrived_at, priority=priority)
+                      arrived_at=arrived_at, priority=priority,
+                      adapter_id=adapter_id)
+        page = self._acquire_adapter(req)
+        if page is None:
+            raise RuntimeError(
+                f"no free adapter page for adapter {adapter_id} — "
+                "probe adapter_page_available before adopting")
         now = time.perf_counter()
         slot = _Slot(req=req, blocks=[int(b) for b in blocks],
                      generated=[int(first_token)],
                      last_token_at=now, prefill_pos=int(prompt.size),
-                     admit_seq=self._admit_counter)
+                     admit_seq=self._admit_counter,
+                     adapter_page=page)
         self._admit_counter += 1
         self._slots[self._slots.index(None)] = slot
         self._m_admissions.inc()
@@ -2168,6 +2396,14 @@ class GenerationEngine:
                 f"drain leak check failed: block(s) {leaked} neither "
                 "free nor prefix-cached after all lanes finished — a "
                 "scheduler path dropped a reference without freeing")
+        if self.adapter_pool is not None:
+            leaked = self.adapter_pool.leak_check()
+            if leaked:
+                raise RuntimeError(
+                    f"drain leak check failed: adapter page(s) "
+                    f"{leaked} still referenced after all lanes "
+                    "finished — a scheduler path vacated a lane "
+                    "without releasing its adapter page")
         self._end_of_step_gauges()
         return out
 

@@ -23,7 +23,11 @@ speculation, quantization) keeps paying per replica. Three layers:
   blocks, but can't starve one replica while others idle. Cold
   requests go least-loaded (stable index tie-break), which is what
   keeps a 1-replica fleet BIT-IDENTICAL to a bare engine: same
-  arrival order, same engine, same compiled steps.
+  arrival order, same engine, same compiled steps. Under multi-tenant
+  adapter serving (`engine_options["adapters"]`) the chain is SALTED
+  with each request's adapter id — exactly the salt the caches use —
+  so a hot base prompt under two tenants routes and caches
+  independently.
 - **Disaggregated prefill/decode** (`num_prefill_replicas > 0`):
   dedicated prefill replicas run chunked prefill to completion
   (`prefill_only` requests — max_new_tokens=1, the token the final
@@ -360,11 +364,16 @@ class ServingFleet:
         return merge_snapshots(snaps)
 
     # -- routing -----------------------------------------------------------
-    def _route(self, prompt):
+    def _route(self, prompt, adapter_id=0):
         """Pick the intake replica: deepest warm `prefix_key` chain
         wins while its backlog stays within `affinity_slack` of the
         least-loaded intake replica; otherwise least-loaded (stable
-        id tie-break). Returns (replica, reason, warm_tokens)."""
+        id tie-break). The chain is salted with `adapter_id` — router
+        keys stay == cache keys, so a hot base prompt under two
+        tenants routes (and caches) independently: each adapter's
+        chain warms its own replica and can never claim affinity to
+        KV another tenant's projections wrote. Returns
+        (replica, reason, warm_tokens)."""
         intake = self._routable(
             "prefill" if self.disaggregated else "mixed")
         if not intake:
@@ -378,7 +387,8 @@ class ServingFleet:
             if keys is None:
                 # hash the prompt ONCE; every replica peek reuses the
                 # digests (replicas are homogeneous in block_size)
-                keys = prefix_key(prompt, r.engine.block_size)
+                keys = prefix_key(prompt, r.engine.block_size,
+                                  adapter_id)
             hit = r.engine.cache.warm_prefix_tokens(prompt, keys=keys)
             if hit > best_hit:
                 best, best_hit = r, hit
@@ -389,17 +399,20 @@ class ServingFleet:
         return cold, "least_loaded", 0
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    req_id=None, priority="standard"):
+                    req_id=None, priority="standard", adapter_id=0):
         """Admit one request into the fleet. Same contract as
         `GenerationEngine.add_request` (priority QoS, auto ids,
-        validation), plus fleet admission control: with `max_queue`
-        set and that many requests already queued fleet-wide, the
-        incoming request is shed (result None — the HTTP-429 of this
-        tier; per-replica `max_queue` still does priority-aware
-        shedding inside each engine). Routing is prefix-affinity
-        first, least-loaded otherwise; in a disaggregated fleet the
-        request lands on a prefill replica as `prefill_only` and the
-        decode budget rides the handoff."""
+        validation, per-tenant `adapter_id` when the replicas carry an
+        adapter registry), plus fleet admission control: with
+        `max_queue` set and that many requests already queued
+        fleet-wide, the incoming request is shed (result None — the
+        HTTP-429 of this tier; per-replica `max_queue` still does
+        priority-aware shedding inside each engine). Routing is
+        prefix-affinity first (adapter-salted — a hot base prompt
+        under two tenants warms two independent chains), least-loaded
+        otherwise; in a disaggregated fleet the request lands on a
+        prefill replica as `prefill_only` and the decode budget rides
+        the handoff."""
         if self._draining:
             raise RuntimeError(
                 "fleet is draining — admissions are closed")
@@ -411,6 +424,11 @@ class ServingFleet:
         if priority not in PRIORITY_CLASSES:
             raise ValueError(f"priority must be one of "
                              f"{PRIORITY_CLASSES}, got {priority!r}")
+        # validate the adapter id BEFORE any router state mutates
+        # (replicas are homogeneous — any engine's checker speaks for
+        # all): an unknown id must reject cleanly, not leave a phantom
+        # in-flight request that deadlocks every later run()
+        adapter_id = self._any_engine()._check_adapter(adapter_id)
         total = prompt.size + int(max_new_tokens)
         limit = self._any_engine().max_model_len
         if total > limit:
@@ -433,7 +451,7 @@ class ServingFleet:
             self._m_shed.labels(priority=priority).inc()
             self._done[req_id] = None
             return req_id
-        rep, reason, warm = self._route(prompt)
+        rep, reason, warm = self._route(prompt, adapter_id)
         self._m_routed.labels(replica=str(rep.rid),
                               reason=reason).inc()
         if warm:
@@ -446,17 +464,20 @@ class ServingFleet:
         info = {"prompt": prompt, "max_new": int(max_new_tokens),
                 "eos": eos_token_id, "priority": priority,
                 "arrived": time.perf_counter(), "replica": rep.rid,
+                "adapter_id": int(adapter_id),
                 "phase": "prefill" if self.disaggregated else "serve"}
         self._requests[req_id] = info
         if self.disaggregated:
             rep.engine.add_request(prompt, 1,
                                    eos_token_id=eos_token_id,
                                    req_id=req_id, priority=priority,
-                                   prefill_only=True)
+                                   prefill_only=True,
+                                   adapter_id=adapter_id)
         else:
             rep.engine.add_request(prompt, max_new_tokens,
                                    eos_token_id=eos_token_id,
-                                   req_id=req_id, priority=priority)
+                                   req_id=req_id, priority=priority,
+                                   adapter_id=adapter_id)
         return req_id
 
     # -- disaggregated handoff ---------------------------------------------
@@ -503,8 +524,11 @@ class ServingFleet:
         the compiled scatter (donated pools), then the lane adopted
         mid-stream. False = no lane/blocks this iteration (the
         handoff stays queued; the stall is counted by the caller)."""
+        info = self._requests[h["req_id"]]
         targets = sorted((r for r in self._routable("decode")
-                          if r.engine.free_lanes > 0),
+                          if r.engine.free_lanes > 0
+                          and r.engine.adapter_page_available(
+                              info.get("adapter_id", 0))),
                          key=lambda r: (r.load, r.rid))
         need = len(h["payload"])
         rep = blocks = None
@@ -531,12 +555,12 @@ class ServingFleet:
                 c.kpool, c.vpool = rep._ingest(
                     c.kpool, c.vpool, kb, vb, jnp.int32(dst))
         req_id = h["req_id"]
-        info = self._requests[req_id]
         eng.adopt_request(info["prompt"], h["first"], blocks,
                           info["max_new"],
                           eos_token_id=info["eos"], req_id=req_id,
                           priority=info["priority"],
-                          arrived_at=info["arrived"])
+                          arrived_at=info["arrived"],
+                          adapter_id=info.get("adapter_id", 0))
         info["phase"] = "decode"
         info["replica"] = rep.rid
         self._m_handoffs.inc()

@@ -176,19 +176,25 @@ class GPTAttention(nn.Layer):
             return out, new_cache
         return out
 
-    def _qkv_heads(self, x, mp_axis):
+    def _qkv_heads(self, x, mp_axis, lora=None, layer=None):
         """Project to per-head q/k/v `[B, S, heads, D]`. Unsharded:
         the fused `[H, 3H]` matmul (3-major reshape, unchanged).
         Under tensor parallel (`mp_axis` set) the serving engine binds
         this layer's qkv weight HEAD-GROUPED as `[H, heads/mp, 3, D]`
         (bias `[heads/mp, 3, D]`): the same full-length dot products
         produce just this shard's heads — column parallelism, so every
-        float op is identical to mp=1 and token parity is exact."""
+        float op is identical to mp=1 and token parity is exact.
+        With `lora` (an `ops.lora.LoraState` — multi-tenant adapter
+        serving) each slot's per-tenant low-rank qkv delta is added in
+        the projection's own layout before the unbind; adapter id 0
+        contributes exact zeros."""
         B, S, H = x.shape
         if mp_axis is None:
             qkv = self.qkv_proj(x)
             qkv = mp.reshape(qkv,
                              [B, S, 3, self.num_heads, self.head_dim])
+            if lora is not None:
+                qkv = qkv + lora.qkv_delta(x, layer, head_major=False)
             return mp.unbind(qkv, axis=2)
         from paddle_tpu.ops import nn_ops
 
@@ -199,38 +205,47 @@ class GPTAttention(nn.Layer):
             None if b is None
             else mp.reshape(b, [lh * 3 * self.head_dim]))
         qkv = mp.reshape(qkv, [B, S, lh, 3, self.head_dim])
+        if lora is not None:
+            # the B pages are head-sharded exactly like the qkv weight
+            # (_tp_plan layout), so the shard's delta covers ITS heads
+            qkv = qkv + lora.qkv_delta(x, layer, head_major=True)
         return mp.unbind(qkv, axis=3)
 
-    def _attn_out(self, out, B, S, mp_axis):
+    def _attn_out(self, out, B, S, mp_axis, lora=None, layer=None):
         """Merge heads and apply the output projection. Under tensor
         parallel the shard's heads are all-gathered to the full
         `[B, S, H]` activation first, and out_proj (bound
         column-sharded `[H, H/mp]`) is followed by a second gather —
         full-length dots + exact concats, never a partial-sum psum, so
         the result is bit-identical to mp=1 (see DESIGN_DECISIONS
-        "Tensor-parallel sharded serving")."""
+        "Tensor-parallel sharded serving"). The per-tenant `lora`
+        delta adds to the (output-sharded) projection before the final
+        gather — same input, same column slice, no extra collective."""
         out = mp.reshape(out, [B, S, -1])
         if mp_axis is not None:
             out = _mp_all_gather(out, mp_axis)
-        out = self.out_proj(out)
+        proj = self.out_proj(out)
+        if lora is not None:
+            proj = proj + lora.linear_delta("out", out, layer)
         if mp_axis is not None:
-            out = _mp_all_gather(out, mp_axis)
-        return out
+            proj = _mp_all_gather(proj, mp_axis)
+        return proj
 
-    def forward_prefill(self, x, mp_axis=None):
+    def forward_prefill(self, x, mp_axis=None, lora=None, layer=None):
         """Causal forward that ALSO returns this layer's k/v for the
         whole (padded) buffer — fills the fixed-size decode cache.
         Under tensor parallel the returned k/v carry only this shard's
         heads (they feed the shard's pool plane)."""
         B, S, H = x.shape
-        q, k, v = self._qkv_heads(x, mp_axis)
+        q, k, v = self._qkv_heads(x, mp_axis, lora=lora, layer=layer)
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=0.0, training=False)
-        return self._attn_out(out, B, S, mp_axis), k, v
+        return self._attn_out(out, B, S, mp_axis, lora=lora,
+                              layer=layer), k, v
 
     def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
                               block_row, start, plen, mp_axis=None,
-                              kv_scales=None):
+                              kv_scales=None, lora=None):
         """Chunked prefill for ONE slot against the paged pool: write
         this chunk's k/v through the slot's block table and attend the
         chunk's queries over the whole context so far (shared prefix
@@ -242,16 +257,19 @@ class GPTAttention(nn.Layer):
         from paddle_tpu.ops.paged_attention import paged_prefill_chunk
 
         B, C, H = x.shape  # B == 1
-        q, k, v = self._qkv_heads(x, mp_axis)
+        q, k, v = self._qkv_heads(x, mp_axis, lora=lora,
+                                  layer=layer_idx)
         if kv_scales is not None:
             out, kpool, vpool, kv_scales = paged_prefill_chunk(
                 q, k, v, kpool, vpool, layer_idx, block_row, start,
                 plen, scales=kv_scales, mp_axis=mp_axis)
-            return (self._attn_out(out, B, C, mp_axis), kpool, vpool,
+            return (self._attn_out(out, B, C, mp_axis, lora=lora,
+                                   layer=layer_idx), kpool, vpool,
                     kv_scales)
         out, kpool, vpool = paged_prefill_chunk(
             q, k, v, kpool, vpool, layer_idx, block_row, start, plen)
-        return self._attn_out(out, B, C, mp_axis), kpool, vpool
+        return self._attn_out(out, B, C, mp_axis, lora=lora,
+                              layer=layer_idx), kpool, vpool
 
     def forward_decode(self, x, kcache, vcache, pos):
         """One-token decode against a FIXED-size cache (the jit-friendly
@@ -288,7 +306,7 @@ class GPTAttention(nn.Layer):
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, backend="auto",
-                             mp_axis=None, kv_scales=None):
+                             mp_axis=None, kv_scales=None, lora=None):
         """Batched one-token decode against the GLOBAL paged KV pool
         (the continuous-batching engine's layer step). x [slots,1,H];
         kpool/vpool [layers, num_blocks, block_size, heads, D];
@@ -300,27 +318,32 @@ class GPTAttention(nn.Layer):
         head-count agnostic, so both backends run per-shard unchanged.
         With `kv_scales` (int8 KV serving) the pools are int8 and the
         updated `[L, blocks, 2]` scale array returns as a 4th output.
+        With `lora` (multi-tenant adapter serving) each slot's tenant
+        delta fuses into the qkv and out projections.
         Returns (out, new_kpool, new_vpool[, new_kv_scales])."""
         from paddle_tpu.ops.paged_attention import paged_attention_step
 
         B, S, H = x.shape  # S == 1
-        q, k, v = self._qkv_heads(x, mp_axis)
+        q, k, v = self._qkv_heads(x, mp_axis, lora=lora,
+                                  layer=layer_idx)
         if kv_scales is not None:
             out, kpool, vpool, kv_scales = paged_attention_step(
                 q, k, v, kpool, vpool, layer_idx, block_tables,
                 positions, backend=backend, scales=kv_scales,
                 mp_axis=mp_axis)
-            return (self._attn_out(out, B, 1, mp_axis), kpool, vpool,
+            return (self._attn_out(out, B, 1, mp_axis, lora=lora,
+                                   layer=layer_idx), kpool, vpool,
                     kv_scales)
         out, kpool, vpool = paged_attention_step(
             q, k, v, kpool, vpool, layer_idx, block_tables, positions,
             backend=backend)
-        return self._attn_out(out, B, 1, mp_axis), kpool, vpool
+        return self._attn_out(out, B, 1, mp_axis, lora=lora,
+                              layer=layer_idx), kpool, vpool
 
     def forward_verify_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, draft_lens,
                              backend="auto", mp_axis=None,
-                             kv_scales=None):
+                             kv_scales=None, lora=None):
         """Speculative K-token verify over the GLOBAL paged pool: one
         fixed `[slots, W]` window per lane (W = K+1: the feed token
         plus the drafts). x [slots,W,H]; positions [slots] absolute
@@ -330,22 +353,28 @@ class GPTAttention(nn.Layer):
         query causally up to its own position — the target model
         scores all W candidate positions in one pass. Returns
         (out [slots,W,H], new_kpool, new_vpool), plus the updated
-        scale array under int8 KV serving (`kv_scales`)."""
+        scale array under int8 KV serving (`kv_scales`). `lora` fuses
+        each slot's tenant delta into the projections, same as the
+        decode step — the verify window scores under the ADAPTED
+        model, so speculative acceptance stays exact per tenant."""
         from paddle_tpu.ops.paged_attention import paged_verify_window
 
         B, W, H = x.shape
-        q, k, v = self._qkv_heads(x, mp_axis)
+        q, k, v = self._qkv_heads(x, mp_axis, lora=lora,
+                                  layer=layer_idx)
         if kv_scales is not None:
             out, kpool, vpool, kv_scales = paged_verify_window(
                 q, k, v, kpool, vpool, layer_idx, block_tables,
                 positions, draft_lens, backend=backend,
                 scales=kv_scales, mp_axis=mp_axis)
-            return (self._attn_out(out, B, W, mp_axis), kpool, vpool,
+            return (self._attn_out(out, B, W, mp_axis, lora=lora,
+                                   layer=layer_idx), kpool, vpool,
                     kv_scales)
         out, kpool, vpool = paged_verify_window(
             q, k, v, kpool, vpool, layer_idx, block_tables, positions,
             draft_lens, backend=backend)
-        return self._attn_out(out, B, W, mp_axis), kpool, vpool
+        return self._attn_out(out, B, W, mp_axis, lora=lora,
+                              layer=layer_idx), kpool, vpool
 
 
 class GPTMLP(nn.Layer):
@@ -370,17 +399,25 @@ class GPTMLP(nn.Layer):
                 self.fc1.bias.dist_spec = P("mp")
             self.fc2.weight.dist_spec = P("mp", None)
 
-    def forward(self, x, mp_axis=None):
+    def forward(self, x, mp_axis=None, lora=None, layer=None):
         """Under tensor parallel (`mp_axis` set, serving engine's
         shard_map step) fc1 AND fc2 are bound column-sharded
         (`[H, I/mp]` / `[I, H/mp]`): each shard's outputs are
         full-length dots over the gathered input, concatenated by a
         tiled all-gather — exact column parallelism both times, never
-        a partial-sum psum, so mp=N output is bit-identical to mp=1."""
-        h = F.gelu(self.fc1(x), approximate=True)
+        a partial-sum psum, so mp=N output is bit-identical to mp=1.
+        The per-tenant `lora` deltas add to the (output-sharded) fc1
+        pre-activation and fc2 output — same inputs, same column
+        slices, no extra collective (adapter id 0 adds exact zeros)."""
+        pre = self.fc1(x)
+        if lora is not None:
+            pre = pre + lora.linear_delta("fc1", x, layer)
+        h = F.gelu(pre, approximate=True)
         if mp_axis is not None:
             h = _mp_all_gather(h, mp_axis)
         out = self.fc2(h)
+        if lora is not None:
+            out = out + lora.linear_delta("fc2", h, layer)
         if mp_axis is not None:
             out = _mp_all_gather(out, mp_axis)
         return self.dropout(out)
@@ -406,27 +443,32 @@ class GPTBlock(nn.Layer):
         x = x + self.mlp(self.ln2(x))
         return x
 
-    def forward_prefill(self, x, mp_axis=None):
+    def forward_prefill(self, x, mp_axis=None, lora=None, layer=None):
         a, k, v = self.attn.forward_prefill(self.ln1(x),
-                                            mp_axis=mp_axis)
+                                            mp_axis=mp_axis,
+                                            lora=lora, layer=layer)
         x = x + a
-        return x + self.mlp(self.ln2(x), mp_axis=mp_axis), k, v
+        return x + self.mlp(self.ln2(x), mp_axis=mp_axis, lora=lora,
+                            layer=layer), k, v
 
     def forward_prefill_chunk(self, x, kpool, vpool, layer_idx,
                               block_row, start, plen, mp_axis=None,
-                              kv_scales=None):
+                              kv_scales=None, lora=None):
         if kv_scales is not None:
             a, kpool, vpool, kv_scales = self.attn.forward_prefill_chunk(
                 self.ln1(x), kpool, vpool, layer_idx, block_row,
-                start, plen, mp_axis=mp_axis, kv_scales=kv_scales)
+                start, plen, mp_axis=mp_axis, kv_scales=kv_scales,
+                lora=lora)
             x = x + a
-            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis,
+                                 lora=lora, layer=layer_idx), kpool,
                     vpool, kv_scales)
         a, kpool, vpool = self.attn.forward_prefill_chunk(
             self.ln1(x), kpool, vpool, layer_idx, block_row, start,
-            plen, mp_axis=mp_axis)
+            plen, mp_axis=mp_axis, lora=lora)
         x = x + a
-        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis, lora=lora,
+                             layer=layer_idx), kpool,
                 vpool)
 
     def forward_decode(self, x, kcache, vcache, pos):
@@ -438,39 +480,44 @@ class GPTBlock(nn.Layer):
 
     def forward_decode_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, backend="auto",
-                             mp_axis=None, kv_scales=None):
+                             mp_axis=None, kv_scales=None, lora=None):
         if kv_scales is not None:
             a, kpool, vpool, kv_scales = self.attn.forward_decode_paged(
                 self.ln1(x), kpool, vpool, layer_idx, block_tables,
                 positions, backend=backend, mp_axis=mp_axis,
-                kv_scales=kv_scales)
+                kv_scales=kv_scales, lora=lora)
             x = x + a
-            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis,
+                                 lora=lora, layer=layer_idx), kpool,
                     vpool, kv_scales)
         a, kpool, vpool = self.attn.forward_decode_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
-            positions, backend=backend, mp_axis=mp_axis)
+            positions, backend=backend, mp_axis=mp_axis, lora=lora)
         x = x + a
-        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis, lora=lora,
+                             layer=layer_idx), kpool,
                 vpool)
 
     def forward_verify_paged(self, x, kpool, vpool, layer_idx,
                              block_tables, positions, draft_lens,
                              backend="auto", mp_axis=None,
-                             kv_scales=None):
+                             kv_scales=None, lora=None):
         if kv_scales is not None:
             a, kpool, vpool, kv_scales = self.attn.forward_verify_paged(
                 self.ln1(x), kpool, vpool, layer_idx, block_tables,
                 positions, draft_lens, backend=backend,
-                mp_axis=mp_axis, kv_scales=kv_scales)
+                mp_axis=mp_axis, kv_scales=kv_scales, lora=lora)
             x = x + a
-            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+            return (x + self.mlp(self.ln2(x), mp_axis=mp_axis,
+                                 lora=lora, layer=layer_idx), kpool,
                     vpool, kv_scales)
         a, kpool, vpool = self.attn.forward_verify_paged(
             self.ln1(x), kpool, vpool, layer_idx, block_tables,
-            positions, draft_lens, backend=backend, mp_axis=mp_axis)
+            positions, draft_lens, backend=backend, mp_axis=mp_axis,
+            lora=lora)
         x = x + a
-        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis), kpool,
+        return (x + self.mlp(self.ln2(x), mp_axis=mp_axis, lora=lora,
+                             layer=layer_idx), kpool,
                 vpool)
 
 
@@ -508,7 +555,7 @@ class GPTModel(nn.Layer):
         return _vocab_parallel_embed(self.wte.weight, token_ids,
                                      mp_axis)
 
-    def forward_prefill(self, input_ids, mp_axis=None):
+    def forward_prefill(self, input_ids, mp_axis=None, lora=None):
         """Fill the decode caches: causal forward over the (padded)
         buffer, collecting per-layer k/v stacked on a leading layer
         axis (single Tensors, so a compiled decode loop carries them).
@@ -517,15 +564,16 @@ class GPTModel(nn.Layer):
         h = self._embed(input_ids, mp_axis) + self.wpe(
             paddle.arange(S, dtype="int32"))
         ks, vs = [], []
-        for blk in self.blocks:
-            h, k, v = blk.forward_prefill(h, mp_axis=mp_axis)
+        for i, blk in enumerate(self.blocks):
+            h, k, v = blk.forward_prefill(h, mp_axis=mp_axis,
+                                          lora=lora, layer=i)
             ks.append(k)
             vs.append(v)
         return self.ln_f(h), mp.stack(ks, axis=0), mp.stack(vs, axis=0)
 
     def forward_prefill_chunk(self, token_ids, start, kpool, vpool,
                               block_row, plen, mp_axis=None,
-                              kv_scales=None):
+                              kv_scales=None, lora=None):
         """Chunked paged prefill (the engine's incremental admission
         path): token_ids [1,C] — chunk `[start, start+C)` of one
         slot's prompt, padded past `plen`; kpool/vpool the global
@@ -549,12 +597,12 @@ class GPTModel(nn.Layer):
             for i, blk in enumerate(self.blocks):
                 h, kpool, vpool, kv_scales = blk.forward_prefill_chunk(
                     h, kpool, vpool, i, block_row, pos_t, plen,
-                    mp_axis=mp_axis, kv_scales=kv_scales)
+                    mp_axis=mp_axis, kv_scales=kv_scales, lora=lora)
             return self.ln_f(h), kpool, vpool, kv_scales
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_prefill_chunk(
                 h, kpool, vpool, i, block_row, pos_t, plen,
-                mp_axis=mp_axis)
+                mp_axis=mp_axis, lora=lora)
         return self.ln_f(h), kpool, vpool
 
     def forward_decode(self, token_ids, pos, kstack, vstack):
@@ -579,7 +627,7 @@ class GPTModel(nn.Layer):
 
     def forward_decode_paged(self, token_ids, positions, kpool, vpool,
                              block_tables, backend="auto",
-                             mp_axis=None, kv_scales=None):
+                             mp_axis=None, kv_scales=None, lora=None):
         """Batched decode step over the paged pool (continuous-batching
         engine path): token_ids [slots,1], positions [slots] int32
         per-slot absolute positions, kpool/vpool
@@ -599,18 +647,18 @@ class GPTModel(nn.Layer):
                 h, kpool, vpool, kv_scales = blk.forward_decode_paged(
                     h, kpool, vpool, i, block_tables, pos_t,
                     backend=backend, mp_axis=mp_axis,
-                    kv_scales=kv_scales)
+                    kv_scales=kv_scales, lora=lora)
             return self.ln_f(h), kpool, vpool, kv_scales
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_decode_paged(
                 h, kpool, vpool, i, block_tables, pos_t,
-                backend=backend, mp_axis=mp_axis)
+                backend=backend, mp_axis=mp_axis, lora=lora)
         return self.ln_f(h), kpool, vpool
 
     def forward_verify_paged(self, token_ids, positions, draft_lens,
                              kpool, vpool, block_tables,
                              backend="auto", mp_axis=None,
-                             kv_scales=None):
+                             kv_scales=None, lora=None):
         """Speculative verify step over the paged pool (the engine's
         K-token decode): token_ids [slots, W] — the feed token plus up
         to W-1 drafted tokens per lane, positions [slots] int32 row-0
@@ -642,12 +690,12 @@ class GPTModel(nn.Layer):
                 h, kpool, vpool, kv_scales = blk.forward_verify_paged(
                     h, kpool, vpool, i, block_tables, pos_t, dlen_t,
                     backend=backend, mp_axis=mp_axis,
-                    kv_scales=kv_scales)
+                    kv_scales=kv_scales, lora=lora)
             return self.ln_f(h), kpool, vpool, kv_scales
         for i, blk in enumerate(self.blocks):
             h, kpool, vpool = blk.forward_verify_paged(
                 h, kpool, vpool, i, block_tables, pos_t, dlen_t,
-                backend=backend, mp_axis=mp_axis)
+                backend=backend, mp_axis=mp_axis, lora=lora)
         return self.ln_f(h), kpool, vpool
 
 

@@ -1,0 +1,126 @@
+"""Batched paged-LoRA apply — the op tier under the multi-tenant
+adapter subsystem (paddle_tpu/adapters/).
+
+S-LoRA / Punica-style batched low-rank updates, XLA edition: every
+serving slot may carry a DIFFERENT tenant adapter, and one compiled
+step serves any tenant mix. The adapter weights live in a paged
+on-device pool (`adapters.PagedAdapterPool` — same block/refcount/LRU
+story as the paged KV cache), stacked per target site:
+
+- `a_<site>`: `[pages, layers, max_rank, in_dim]` — the LoRA A factors
+  (rank-major, rank-padded with EXACT zeros past each adapter's rank);
+- `b_<site>`: `[pages, layers, max_rank, ...out layout]` — the B
+  factors in the layout the base matmul's output takes (`b_qkv` is
+  head-grouped `[.., heads, 3, head_dim]` so it shards on the heads
+  axis exactly like the engine's `_tp_plan` qkv weight; the linear
+  sites' `[.., out]` shard their output columns);
+- `scaling`: `[pages]` f32 — each adapter's `alpha / rank` factor.
+
+`LoraState` is the traced-side view one compiled engine step holds: the
+pool arrays plus a `[slots]` int32 page row (the per-slot adapter page,
+resolved host-side from adapter ids by the pool). Page 0 is the NULL
+adapter: all-zero factors and zero scaling, so a base-model slot's
+delta is EXACTLY zero (`base + 0.0` — adapter id 0 stays bit-identical
+to an engine with no adapter subsystem at all). Rank padding works the
+same way: a rank-r adapter's rows past r are exact zeros, so ONE trace
+shape (`max_rank`) serves every rank without masks or per-rank
+programs.
+
+Numerics: both einsums of the delta (`x . A^T` then `. B^T`) pin fp32
+accumulation (`preferred_element_type`), the per-slot scaling is
+applied in fp32, and the result is cast to the activation dtype ONCE —
+the same policy as the paged-attention PV accumulation. No collectives
+at any mp: A rides replicated against the full-length activation, B is
+output-column-sharded, so each shard computes exactly its own slice of
+the delta and the existing all-gathers reassemble base + delta
+together.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import apply, as_tensor
+
+__all__ = ["LORA_SITES", "LoraState", "lora_linear_delta",
+           "lora_qkv_delta"]
+
+#: The base-model matmuls an adapter may target, in pool-array order.
+#: (qkv/out are the attention projections, fc1/fc2 the MLP — the four
+#: per-step weight reads the serving engine's int8 weight path also
+#: targets.)
+LORA_SITES = ("qkv", "out", "fc1", "fc2")
+
+
+def lora_linear_delta(x, a, b, rows, scaling, layer):
+    """Per-slot low-rank delta for one linear site, one layer.
+
+    x: `[B, S, in]` — the SAME activation the base matmul consumes.
+    a: `[pages, layers, max_rank, in]`; b: `[pages, layers, max_rank,
+    out]` (out may be the per-shard column count under mp).
+    rows: `[B]` int32 adapter-pool page per slot (0 = null adapter).
+    scaling: `[pages]` f32. layer: python int (static).
+
+    Returns `[B, S, out]` in x.dtype: `(x . A^T . B^T) * scaling`,
+    fp32-accumulated, exact zeros for null/rank-padded rows."""
+    x, a, b = as_tensor(x), as_tensor(a), as_tensor(b)
+    rows, scaling = as_tensor(rows), as_tensor(scaling)
+
+    def fn(xa, av, bv, rw, sc):
+        al = av[rw, layer]                         # [B, R, in]
+        bl = bv[rw, layer]                         # [B, R, out]
+        s = sc[rw].astype(jnp.float32)             # [B]
+        xr = jnp.einsum("bsi,bri->bsr", xa, al,
+                        preferred_element_type=jnp.float32)
+        d = jnp.einsum("bsr,bro->bso", xr, bl,
+                       preferred_element_type=jnp.float32)
+        return (d * s[:, None, None]).astype(xa.dtype)
+
+    return apply("lora_linear_delta", fn, x, a, b, rows, scaling)
+
+
+def lora_qkv_delta(x, a, b, rows, scaling, layer, head_major):
+    """The qkv site's delta, in the layout the base qkv projection
+    takes: b is head-grouped `[pages, layers, max_rank, heads, 3, D]`
+    (per-shard heads under mp). `head_major=True` returns
+    `[B, S, heads, 3, D]` (the sharded `_qkv_heads` layout),
+    False returns `[B, S, 3, heads, D]` (the unsharded reshape)."""
+    x, a, b = as_tensor(x), as_tensor(a), as_tensor(b)
+    rows, scaling = as_tensor(rows), as_tensor(scaling)
+    out = "bshtd" if head_major else "bsthd"
+
+    def fn(xa, av, bv, rw, sc):
+        al = av[rw, layer]                         # [B, R, H]
+        bl = bv[rw, layer]                         # [B, R, heads, 3, D]
+        s = sc[rw].astype(jnp.float32)
+        xr = jnp.einsum("bsi,bri->bsr", xa, al,
+                        preferred_element_type=jnp.float32)
+        d = jnp.einsum(f"bsr,brhtd->{out}", xr, bl,
+                       preferred_element_type=jnp.float32)
+        return (d * s[:, None, None, None, None]).astype(xa.dtype)
+
+    return apply("lora_qkv_delta", fn, x, a, b, rows, scaling)
+
+
+class LoraState:
+    """One compiled step's view of the adapter pool: the pool arrays
+    (traced args, in `adapters.adapter_pool_spec` order) plus the
+    per-slot `[B]` page row. Built INSIDE the step body; the model's
+    forward paths call the delta methods per layer and add the result
+    to the base matmul's output."""
+
+    def __init__(self, arrays, rows):
+        (self.a_qkv, self.b_qkv, self.a_out, self.b_out,
+         self.a_fc1, self.b_fc1, self.a_fc2, self.b_fc2,
+         self.scaling) = arrays
+        self.rows = rows
+
+    def qkv_delta(self, x, layer, head_major):
+        return lora_qkv_delta(x, self.a_qkv, self.b_qkv, self.rows,
+                              self.scaling, layer, head_major)
+
+    def linear_delta(self, site, x, layer):
+        a, b = {"out": (self.a_out, self.b_out),
+                "fc1": (self.a_fc1, self.b_fc1),
+                "fc2": (self.a_fc2, self.b_fc2)}[site]
+        return lora_linear_delta(x, a, b, self.rows, self.scaling,
+                                 layer)

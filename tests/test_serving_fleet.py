@@ -484,3 +484,92 @@ def test_fleet_offered_load_bench_runner_tiny(model):
     assert rec["tokens_per_s"] > 0 and rec["tokens_per_s_r1"] > 0
     assert rec["affinity_hit_tokens"] > 0
     assert rec["prefix_hit_tokens"] > 0
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant adapters (ISSUE 13 satellite): adapter-salted routing
+# ---------------------------------------------------------------------------
+
+def _lora_registry(cfg, seed=3):
+    from paddle_tpu.adapters import AdapterRegistry
+
+    rng = np.random.RandomState(seed)
+    reg = AdapterRegistry(cfg, max_rank=2)
+    H, L = cfg.hidden_size, cfg.num_layers
+    for aid in (1, 2):
+        w = {"qkv": [(rng.randn(2, H).astype(np.float32) * 0.5,
+                      rng.randn(3 * H, 2).astype(np.float32) * 0.5)
+                     for _ in range(L)]}
+        reg.register(aid, w, scaling=0.5)
+    return reg
+
+
+def test_adapter_salted_affinity_routes_tenants_independently(model):
+    """ISSUE 13 satellite: `prefix_key`'s affinity chain carries the
+    SAME adapter-id salt the caches hash with (router keys stay ==
+    cache keys), so a hot base prompt under two adapters routes AND
+    caches independently — each tenant's requests land on the replica
+    owning ITS chain, and neither can claim the other's KV."""
+    reg = _lora_registry(model.config)
+    fleet = ServingFleet(model, num_replicas=2, num_slots=2,
+                         block_size=8, prefill_chunk=8, adapters=reg)
+    reps = list(fleet._replicas.values())
+    p = (np.arange(16, dtype=np.int32) % VOCAB)
+    # warm each tenant's chain on its own replica (driving the engines
+    # directly pins placement)
+    reps[0].engine.add_request(p, 2, adapter_id=1)
+    reps[0].engine.run()
+    reps[1].engine.add_request(p, 2, adapter_id=2)
+    reps[1].engine.run()
+    # router keys ARE cache keys, per tenant: the salted digests peek
+    # exactly the chain that tenant's prefill registered
+    assert reps[0].engine.cache.warm_prefix_tokens(
+        p, keys=prefix_key(p, 8, 1)) == 16
+    assert reps[0].engine.cache.warm_prefix_tokens(
+        p, keys=prefix_key(p, 8, 2)) == 0
+    rep, reason, warm = fleet._route(p, 1)
+    assert (rep.rid, reason, warm) == (reps[0].rid, "affinity", 16)
+    rep, reason, warm = fleet._route(p, 2)
+    assert (rep.rid, reason, warm) == (reps[1].rid, "affinity", 16)
+    # the base adapter owns neither chain: cold, least-loaded
+    rep, reason, warm = fleet._route(p, 0)
+    assert reason == "least_loaded" and warm == 0
+    # end-to-end: each tenant's request lands on ITS warm replica and
+    # actually hits (hit tokens grow there, never cross-tenant)
+    h0 = reps[0].engine.prefix_hit_tokens
+    h1 = reps[1].engine.prefix_hit_tokens
+    r1 = fleet.add_request(p, 3, adapter_id=1)
+    r2 = fleet.add_request(p, 3, adapter_id=2)
+    out = fleet.run()
+    assert reps[0].engine.prefix_hit_tokens == h0 + 16
+    assert reps[1].engine.prefix_hit_tokens == h1 + 16
+    assert out[r1] != out[r2]
+    snap = fleet.metrics_snapshot()
+    routed = {(s["labels"]["replica"], s["labels"]["reason"]):
+              s["value"] for s in snap["fleet_routed_total"]["series"]}
+    assert routed[(str(reps[0].rid), "affinity")] == 1
+    assert routed[(str(reps[1].rid), "affinity")] == 1
+
+
+def test_unknown_adapter_rejected_before_router_state(model):
+    """Regression: an unregistered adapter_id must reject CLEANLY at
+    fleet intake — before the routing record exists — or the phantom
+    in-flight request deadlocks every later run() and strands all
+    other results."""
+    reg = _lora_registry(model.config)
+    fleet = ServingFleet(model, num_replicas=2, num_slots=2,
+                         block_size=8, prefill_chunk=8, adapters=reg)
+    p = (np.arange(9, dtype=np.int32) % VOCAB)
+    good = fleet.add_request(p, 2, adapter_id=1)
+    with pytest.raises(ValueError, match="not registered"):
+        fleet.add_request(p, 2, adapter_id=99)
+    # no adapter subsystem at all: nonzero ids reject the same way
+    bare = ServingFleet(model, num_replicas=1, num_slots=2,
+                        block_size=8, prefill_chunk=8)
+    with pytest.raises(ValueError, match="adapters="):
+        bare.add_request(p, 2, adapter_id=1)
+    assert fleet.num_outstanding == 1          # no phantom request
+    out = fleet.run()                          # and the fleet still runs
+    assert list(out) == [good]
+    snap = fleet.metrics_snapshot()
+    assert series_total(snap, "fleet_routed_total") == 1

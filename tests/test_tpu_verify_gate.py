@@ -68,9 +68,13 @@ def test_matrix_is_contract_clean(matrix_result):
     # backend/K/kv-divergent decode/verify steps plus the 12 per-
     # (mp, kv_dtype) backend-invariant programs, every contract seen
     # — the kv=int8 half is the PR-11 quantized serving config (int8
-    # per-block-scaled KV pools + int8 weights)
-    assert len(res.programs) == 28
-    assert sum(",int8" in p.config for p in res.programs) == 14
+    # per-block-scaled KV pools + int8 weights) — plus the 4 PR-13
+    # adapter-threaded programs (LORA_CONFIGS: a plain fp mp=1
+    # decode + both prefills, and the composed
+    # pallas/K=4/mp=2/int8 verify step)
+    assert len(res.programs) == 32
+    assert sum(",int8" in p.config for p in res.programs) == 15
+    assert sum(",lora" in p.config for p in res.programs) == 4
     names = {p.contract.name for p in res.programs}
     assert names == {"engine_decode_step", "engine_verify_step",
                      "engine_prefill", "engine_prefill_chunk",
@@ -225,4 +229,4 @@ def test_cli_acceptance_command_exits_zero():
         [sys.executable, os.path.join(REPO, "tools", "tpu_verify.py")],
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "tpu-verify clean: 28 programs" in res.stdout
+    assert "tpu-verify clean: 32 programs" in res.stdout

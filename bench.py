@@ -238,8 +238,76 @@ def bench_resnet50(on_tpu):
         f"batch={batch} size={size} steps={steps} compile={compile_s:.1f}s "
         f"step={dt/steps*1000:.1f}ms loss={float(loss):.3f} "
         "| hbm-roofline row: early stages ~90% of bandwidth bound; "
-        "r5 fusion probe: perfect conv+BN fusion caps at ~0.20 MFU and "
-        "needs a custom conv suite (see header + DESIGN_DECISIONS)")
+        "r5 fusion probe: perfect conv+BN fusion caps at ~0.20 MFU — "
+        "the custom conv suite now exists (ops/pallas/conv.py, eval "
+        "path; BENCH_MODEL=resnet50_infer + bench_ops conv_fused_sweep "
+        "measure it) and the training-graph fusion is the follow-up")
+
+
+def bench_resnet50_infer(on_tpu):
+    """ResNet-50 EVAL forward through the fused Pallas conv suite
+    (ISSUE 14): the same synthetic-data geometry as the training row,
+    served once with `conv_backend='dense'` (today's conv->BN->ReLU
+    composition — the r5 fusion-probe ceiling) and once with
+    `conv_backend='pallas'` (every bottleneck conv+BN+ReLU one fused
+    kernel, `PADDLE_CONV_BACKEND` seam). Outputs are tolerance-
+    asserted before timing; the emitted metric is the FUSED images/s,
+    with the dense number in the info line. Named-row only
+    (`BENCH_MODEL=resnet50_infer`) so the default three-row output —
+    and the committed BENCH_BASELINE metric set — is unchanged until
+    a TPU run decides a baseline for it."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        batch, size, classes = 256, 224, 1000
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        fwd_flops = RESNET50_FWD_FLOPS
+    else:
+        batch, size, classes, steps = 4, 32, 10, 2
+        fwd_flops = RESNET50_FWD_FLOPS * (32 / 224) ** 2
+
+    imgs = np.random.uniform(-1, 1, (batch, 3, size, size)) \
+        .astype(np.float32)
+    x = paddle.to_tensor(imgs).astype("bfloat16")
+
+    def serve(backend):
+        paddle.seed(0)                  # identical weights per build
+        model = resnet50(num_classes=classes, conv_backend=backend)
+        model.to(dtype="bfloat16")
+        model.eval()
+        fwd = jax.jit(lambda a: model(Tensor._wrap(a))._array)
+        t0 = time.time()
+        out = fwd(x._array)
+        np.asarray(out)                 # compile + first run
+        compile_s = time.time() - t0
+        t1 = time.time()
+        for _ in range(steps):
+            out = fwd(x._array)
+        np.asarray(out)
+        return out, (time.time() - t1) / steps, compile_s
+
+    out_d, dt_d, _ = serve("dense")
+    out_p, dt_p, compile_s = serve("pallas")
+    ref = np.asarray(out_d, np.float32)
+    got = np.asarray(out_p, np.float32)
+    err = np.max(np.abs(got - ref)) / max(np.max(np.abs(ref)), 1e-6)
+    from bench_ops import CONV_FUSED_REL_TOL
+
+    assert err <= CONV_FUSED_REL_TOL, \
+        f"fused eval diverged from dense ({err:.4f}, budget " \
+        f"{CONV_FUSED_REL_TOL})"
+    imgs_s = batch / dt_p
+    return _emit(
+        "resnet50_infer_images_per_sec_per_chip", "images/s", imgs_s,
+        fwd_flops, on_tpu,
+        f"batch={batch} size={size} compile={compile_s:.1f}s "
+        f"fused={dt_p*1000:.1f}ms dense={dt_d*1000:.1f}ms "
+        f"dense_images_s={batch/dt_d:.0f} rel_err={err:.4f}")
 
 
 def main():
@@ -249,7 +317,8 @@ def main():
     on_tpu = backend in ("tpu", "axon")
     which = os.environ.get("BENCH_MODEL", "all")
     table = {"gpt": bench_gpt, "bert": bench_bert,
-             "resnet50": bench_resnet50}
+             "resnet50": bench_resnet50,
+             "resnet50_infer": bench_resnet50_infer}
     if which == "all":
         # every BASELINE.md model row, one JSON line each — the GPT
         # flagship LAST so a last-line parser still reads the headline

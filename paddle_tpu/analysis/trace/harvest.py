@@ -145,12 +145,16 @@ def harvest(matrix=None):
     serve int8 per-block-scaled KV AND int8 weights — the full
     quantized serving shape. The LORA_CONFIGS entries add the
     adapter-threaded programs (4 more: a dense mp=1 decode + both
-    prefills, and the composed pallas/K=4/mp=2/int8 verify)."""
+    prefills, and the composed pallas/K=4/mp=2/int8 verify). The
+    default (full) harvest also carries the fused Pallas conv suite's
+    4 programs (`_conv_programs`) so their lowering is drift-gated
+    like every engine step."""
     import jax.numpy as jnp
     import numpy as np
 
     from paddle_tpu.inference.engine import GenerationEngine
 
+    include_conv = matrix is None
     matrix = default_matrix() if matrix is None else tuple(
         (*m, None, False)[:5] if len(m) < 5 else m for m in matrix)
     for _, _, mp, _, _ in matrix:
@@ -256,7 +260,23 @@ def harvest(matrix=None):
                 programs.append(_trace_one(
                     "engine_cow_copy", f"mp={mp}{tag}", eng._cow_pure,
                     eng._cow, cow_args, mp, L))
+    if include_conv:
+        programs.extend(_conv_programs())
     return programs
+
+
+def _conv_programs():
+    """The fused Pallas conv suite's programs (ops/pallas/conv.py):
+    one tiny-but-real jitted instance per kernel family x stride,
+    interpret-mode on CPU like the pallas attention configs. Not part
+    of the engine matrix — they ride the DEFAULT harvest only, so a
+    test harvesting a restricted engine matrix sees exactly what it
+    asked for."""
+    from paddle_tpu.ops.pallas import conv as pallas_conv
+
+    return [_trace_one(name, config, pure, jitted, args, 1, 1)
+            for name, config, pure, jitted, args
+            in pallas_conv.harvest_programs()]
 
 
 # ---------------------------------------------------------------------------

@@ -26,6 +26,7 @@ from .norm import (
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
     InstanceNorm2D, LayerNorm, LocalResponseNorm, RMSNorm, SyncBatchNorm,
 )
+from .fused import ConvBNReLU, fold_bn_into_conv, fuse_conv_bn
 from .pooling import (
     AdaptiveAvgPool2D, AdaptiveMaxPool2D, AvgPool1D, AvgPool2D, MaxPool1D,
     MaxPool2D,

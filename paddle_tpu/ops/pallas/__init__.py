@@ -1,9 +1,12 @@
 """Pallas TPU kernels — the analog of the reference's hand-written fused
 CUDA ops (paddle/fluid/operators/fused/): where XLA's automatic fusion
-isn't enough (flash attention, MoE block matmuls), we drop to Pallas.
+isn't enough (flash attention, paged-attention decode, conv+BN+ReLU),
+we drop to Pallas.
 """
+from .conv import fused_conv_bn_relu, resolve_conv_backend
 from .flash_attention import flash_attention, pallas_sdpa_forward
 from .paged_attention import paged_decode_attention
 
 __all__ = ["flash_attention", "pallas_sdpa_forward",
-           "paged_decode_attention"]
+           "paged_decode_attention", "fused_conv_bn_relu",
+           "resolve_conv_backend"]

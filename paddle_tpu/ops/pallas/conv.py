@@ -1,0 +1,507 @@
+"""Fused conv+BN+ReLU Pallas kernels — the custom conv suite the
+ResNet-50 MFU plateau calls for (ROADMAP item 5, DESIGN_DECISIONS r17).
+
+BENCH_r05 and the `conv_c2_*`/`conv_c5_*` sweep in bench_ops.py put
+numbers on the problem: the stage-1/2 ResNet shapes run at 24-76
+TFLOP/s through `lax.conv_general_dilated` against 184 TFLOP/s for a
+same-FLOP matmul, and the r5 fusion probe showed even perfect XLA
+conv+BN fusion caps at ~0.20 MFU — the early stages are ~90%
+bandwidth-bound on activation re-reads between conv, BN and ReLU.
+These kernels attack exactly that traffic: ONE HBM read of the
+activation, the conv as explicit MXU matmuls with fp32 accumulation,
+and the BatchNorm scale/shift + ReLU applied in-register before the
+single HBM write-back.
+
+Two kernel families cover the ResNet bottleneck sweep:
+
+- 1x1 convs (`_conv1x1_kernel`): a 1x1 conv IS a matmul — the input is
+  viewed as `[N*Ho*Wo, Cin]`, tiled over rows, and each grid program
+  runs one `[TM, Cin] x [Cin, Cout]` MXU pass with the epilogue fused.
+  This alone targets `conv_c2_1x1_64_256` and `conv_c5_1x1_512_2048`,
+  the worst matmul-gap rows of the sweep. Stride-2 1x1 (the downsample
+  path) pre-slices the input — exact, and the slice is 1/4 the read.
+- 3x3 stride-1/2 convs (`_conv3x3_kernel`): implicit GEMM. One grid
+  program per image streams output-row slabs of the (pre-padded) input
+  HBM->VMEM through a double-buffered scratch — the next slab's DMA in
+  flight behind the current slab's compute, halo rows riding inside
+  each slab — and computes the conv as 9 shifted `[TH*Wo, Cin] x
+  [Cin, Cout]` tap matmuls accumulated in fp32
+  (`preferred_element_type`; tpu-verify TPU103 pins it), epilogue
+  fused, one output write.
+
+Padding is materialized once with `jnp.pad` before the 3x3 kernel (a
+single fused memset+copy) so every slab DMA is in-bounds with a static
+shape; the win this suite claims is eliminating the BN/ReLU activation
+round-trips, which dwarf the one-off pad. Both `"SAME"` (the bench
+sweep's convention — asymmetric at stride 2) and paddle's explicit
+symmetric padding (the ResNet blocks' convention) resolve to the same
+VALID-over-padded-input geometry, so one kernel serves both.
+
+Backend seam — the `ops/paged_attention.py` pattern verbatim:
+`resolve_conv_backend` maps `auto`/`dense`/`pallas` (env override
+`PADDLE_CONV_BACKEND` wins, resolved ONCE at block construction by
+`nn/fused.py`); `auto` picks the fused kernel only on TPU at supported
+shapes; explicit `pallas` off-TPU runs the interpreter (the CPU CI
+path, tested numerically against the dense composition like the
+paged-attention kernels); unsupported shapes — the 7x7/s2 stem,
+grouped/dilated convs, ragged channel counts — fall back to `dense`
+CLEANLY whatever was requested, and `CONV_PATH_STATS` records every
+dispatch so a silent fallback is impossible (flash_attention
+PATH_STATS precedent).
+
+The fused path is a FORWARD (inference/eval) op: training keeps the
+differentiable dense composition (`nn/fused.py` routes by mode), and
+the dense foil is also the exactness reference for every test and
+bench row. TraceContracts for both kernel families are declared here,
+colocated with the builders, and `harvest_programs()` hands tpu-verify
+tiny-but-real jitted instances so their lowering is gated like every
+other compiled program.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.trace.contracts import TraceContract, \
+    register_contract
+
+__all__ = ["fused_conv_bn_relu", "conv_bn_relu_reference",
+           "resolve_conv_backend", "conv_shapes_supported",
+           "conv_geometry_tileable", "normalize_conv_padding",
+           "CONV_BACKENDS", "CONV_PATH_STATS",
+           "reset_conv_path_stats", "harvest_programs",
+           "CONV_HARVEST_SHAPES"]
+
+CONV_BACKENDS = ("auto", "dense", "pallas")
+
+# which backend a fused-conv dispatch actually ran, incremented per
+# call (per TRACE under jit). Tests read it to prove the requested
+# kernel engaged / the stem fell back — never a silent fallback.
+CONV_PATH_STATS = {"dense": 0, "pallas": 0}
+
+
+def reset_conv_path_stats():
+    CONV_PATH_STATS["dense"] = 0
+    CONV_PATH_STATS["pallas"] = 0
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu" or \
+            jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _pair(v=1):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 2
+
+
+def normalize_conv_padding(padding=0, kernel=3, stride=1, in_hw=None):
+    """Paddle/lax padding spec -> ((top, bottom), (left, right)).
+
+    Accepts an int, a 2-int per-dim pad, 2 (lo, hi) pairs, or the
+    "SAME"/"VALID" strings. "SAME" needs `in_hw` because lax pads it
+    asymmetrically at stride > 1 (total = (ceil(d/s)-1)*s + k - d, lo =
+    total//2) — the bench sweep's convention, distinct from the ResNet
+    blocks' symmetric padding=1."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return ((0, 0), (0, 0))
+        if p == "SAME":
+            if in_hw is None:
+                raise ValueError("SAME padding needs the input H/W")
+            out = []
+            for d, k, s in zip(in_hw, (kh, kw), (sh, sw)):
+                total = max((-(-d // s) - 1) * s + k - d, 0)
+                out.append((total // 2, total - total // 2))
+            return tuple(out)
+        raise ValueError(f"unsupported conv padding {padding!r}")
+    if isinstance(padding, (list, tuple)):
+        if len(padding) == 2 and all(
+                isinstance(p, (list, tuple)) for p in padding):
+            return tuple((int(lo), int(hi)) for lo, hi in padding)
+        if len(padding) == 2:
+            return tuple((int(p), int(p)) for p in padding)
+        if len(padding) == 4:
+            return ((int(padding[0]), int(padding[1])),
+                    (int(padding[2]), int(padding[3])))
+        raise ValueError(f"unsupported conv padding {padding!r}")
+    p = int(padding)
+    return ((p, p), (p, p))
+
+
+def conv_shapes_supported(kernel=3, stride=1, in_channels=8,
+                          out_channels=8, dilation=1, groups=1,
+                          padding=0):
+    """Static-shape gate for the fused kernels: k in {1, 3} square,
+    stride in {1, 2} square, no dilation/groups, channel counts in
+    multiples of 8 (sublane-friendly tiles), and zero padding for the
+    1x1 family (a padded 1x1 conv is not a matmul). Everything else —
+    the 7x7/s2 stem above all — runs the dense composition; callers
+    resolve ONCE so the answer never flips mid-serving."""
+    kh, kw = _pair(kernel)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilation)
+    if (kh, kw) not in ((1, 1), (3, 3)) or kh != kw:
+        return False
+    if sh != sw or sh not in (1, 2):
+        return False
+    if dh != 1 or dw != 1 or groups != 1:
+        return False
+    if in_channels % 8 or out_channels % 8:
+        return False
+    if (kh, kw) == (1, 1) and not isinstance(padding, str):
+        pads = normalize_conv_padding(padding, kernel, stride,
+                                      in_hw=(8, 8))
+        if any(p != (0, 0) for p in pads):
+            return False
+    return True
+
+
+def conv_geometry_tileable(kernel=3, stride=1, padding=0, in_hw=None):
+    """Per-call geometry gate for the 3x3 family — the H/W-dependent
+    half `conv_shapes_supported` (static, construction-time) cannot
+    see: True when the output rows tile within the kernel's unroll
+    bound and every slab DMA lands in-bounds of the padded input.
+    1x1 geometries always tile (the row-tile pad covers any M).
+    `nn/fused.py` checks this per forward and runs the dense
+    composition when it fails — the same clean-fallback contract as
+    the static gate, just resolved at the first shape-bearing call."""
+    kh, kw = _pair(kernel)
+    if (kh, kw) == (1, 1):
+        return True
+    sh, _ = _pair(stride)
+    pads = normalize_conv_padding(padding, kernel, stride, in_hw=in_hw)
+    (pt, pb) = pads[0]
+    hp = int(in_hw[0]) + pt + pb
+    ho = (hp - 3) // sh + 1
+    wo = (int(in_hw[1]) + sum(pads[1]) - 3) // sh + 1
+    if ho < 1 or wo < 1:
+        return False
+    th = _pick_h_tile(ho)
+    num_tiles = ho // th
+    if num_tiles > 16:                        # unroll-depth bound
+        return False
+    slab = sh * (th - 1) + 3
+    return sh * (num_tiles - 1) * th + slab <= hp
+
+
+def resolve_conv_backend(backend=None, *, kernel=(3, 3), stride=(1, 1),
+                         in_channels=8, out_channels=8, dilation=1,
+                         groups=1, padding=0):
+    """Resolve `auto`/`dense`/`pallas` to the backend a fused conv
+    block will run — ONCE, at construction (the paged-attention
+    `resolve_backend` pattern). The `PADDLE_CONV_BACKEND` env override
+    wins over the constructor argument (deploy semantics). Unsupported
+    static shapes resolve `dense` whatever was requested — the clean
+    fallback the 7x7 stem rides — while a supported shape honours an
+    explicit `dense`/`pallas` (off-TPU, `pallas` runs the interpreter:
+    the CPU CI path); `auto` picks the fused kernel only on TPU."""
+    requested = os.environ.get("PADDLE_CONV_BACKEND") or backend \
+        or "auto"
+    if requested not in CONV_BACKENDS:
+        raise ValueError(f"conv backend must be one of {CONV_BACKENDS}, "
+                         f"got {requested!r}")
+    if not conv_shapes_supported(kernel, stride, in_channels,
+                                 out_channels, dilation, groups,
+                                 padding):
+        return "dense"
+    if requested != "auto":
+        return requested
+    return "pallas" if _on_tpu() else "dense"
+
+
+# ---------------------------------------------------------------------------
+# dense reference (the exactness foil)
+# ---------------------------------------------------------------------------
+
+def conv_bn_relu_reference(x, w, scale, shift, stride=1, padding=0,
+                           relu=True):
+    """The dense `lax.conv_general_dilated` composition the fused
+    kernels are tested and benched against: conv with fp32
+    accumulation, BN scale/shift in fp32, optional ReLU, ONE cast back
+    to the input dtype. x `[N, H, W, Cin]`, w `[kh, kw, Cin, Cout]`,
+    scale/shift `[Cout]` f32 (the folded BatchNorm affine)."""
+    sh, sw = _pair(stride)
+    pads = normalize_conv_padding(padding, w.shape[:2], stride,
+                                  in_hw=x.shape[1:3])
+    out = jax.lax.conv_general_dilated(
+        x, w, (sh, sw), list(pads),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    out = out * scale.astype(jnp.float32) + shift.astype(jnp.float32)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# 1x1 family: the conv IS a matmul
+# ---------------------------------------------------------------------------
+
+def _conv1x1_kernel(x_ref, w_ref, scale_ref, shift_ref, o_ref, *, relu):
+    """One `[TM, Cin] x [Cin, Cout]` MXU pass, epilogue in-register:
+    fp32 accumulation, BN scale/shift, optional ReLU, one cast."""
+    acc = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    y = acc * scale_ref[...] + shift_ref[...]      # [TM,Cout]*[1,Cout]
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _pick_row_tile(m=8):
+    """Row-tile for the 1x1 matmul: a power-of-two divisor keeps every
+    grid step identical; otherwise the wrapper zero-pads M up to the
+    tile (the pad rows are sliced off after — ~one tile of waste)."""
+    for tm in (512, 256, 128):
+        if m % tm == 0:
+            return tm
+    return 128 if m >= 128 else 8
+
+
+def _conv1x1_call(x2, w2, scale, shift, relu, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, Cin = x2.shape
+    Cout = w2.shape[1]
+    TM = _pick_row_tile(M)
+    pad = (-M) % TM
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_conv1x1_kernel, relu=relu),
+        grid=((M + pad) // TM,),
+        in_specs=[
+            pl.BlockSpec((TM, Cin), lambda i: (i, 0)),
+            pl.BlockSpec((Cin, Cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, Cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, Cout), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((TM, Cout), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((M + pad, Cout), x2.dtype),
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x2, w2, scale.reshape(1, Cout), shift.reshape(1, Cout))
+    return out[:M] if pad else out
+
+
+# ---------------------------------------------------------------------------
+# 3x3 family: implicit GEMM over streamed input slabs
+# ---------------------------------------------------------------------------
+
+def _conv3x3_kernel(xp_ref, w_ref, scale_ref, shift_ref, o_ref,
+                    xbuf, copy_sems, *, stride, th, num_tiles, wo,
+                    relu):
+    """One program per image. xp_ref is the PADDED `[N, Hp, Wp, Cin]`
+    input left in ANY/HBM; the program walks `num_tiles` output-row
+    tiles of height `th`, streaming each tile's input slab (the
+    `stride*(th-1)+3` rows it reads, halo included) into the
+    double-buffered VMEM scratch `xbuf` with the next slab's DMA in
+    flight behind the current slab's 9 tap matmuls. The epilogue (BN
+    scale/shift + optional ReLU) runs on the fp32 accumulator before
+    the single cast + output-tile write."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = pl.program_id(0)
+    slab = stride * (th - 1) + 3
+    _, wp, cin = xbuf.shape[1:]
+    cout = w_ref.shape[3]
+
+    def slab_copy(t, buf):
+        return pltpu.make_async_copy(
+            xp_ref.at[n, pl.ds(t * th * stride, slab)],
+            xbuf.at[buf], copy_sems.at[buf])
+
+    slab_copy(0, 0).start()
+    for t in range(num_tiles):                # static unroll (<= 16)
+        if t + 1 < num_tiles:
+            slab_copy(t + 1, (t + 1) % 2).start()
+        slab_copy(t, t % 2).wait()
+        x = xbuf[t % 2]                       # [slab, Wp, Cin]
+        acc = jnp.zeros((th * wo, cout), jnp.float32)
+        for dy in range(3):
+            for dx in range(3):
+                xs = jax.lax.slice(
+                    x, (dy, dx, 0),
+                    (dy + stride * (th - 1) + 1,
+                     dx + stride * (wo - 1) + 1, cin),
+                    (stride, stride, 1))      # [th, Wo, Cin]
+                acc = acc + jax.lax.dot_general(
+                    xs.reshape(th * wo, cin), w_ref[dy, dx],
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+        y = acc * scale_ref[...] + shift_ref[...]
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        o_ref[0, t * th:(t + 1) * th] = \
+            y.reshape(th, wo, cout).astype(o_ref.dtype)
+
+
+def _pick_h_tile(ho=8):
+    """Output-row tile: the largest divisor of Ho <= 8 (TH=1 always
+    divides, so every Ho has a tile); the kernel's unrolled tile walk
+    is bounded by the caller via conv_shapes_supported + the <= 16
+    check in the wrapper."""
+    for th in (8, 7, 6, 5, 4, 3, 2, 1):
+        if ho % th == 0:
+            return th
+    return 1
+
+
+def _conv3x3_call(x, w, scale, shift, stride=1, pads=None, relu=True,
+                  interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    N, H, W, Cin = x.shape
+    Cout = w.shape[3]
+    s = stride
+    (pt, pb), (plft, prgt) = pads if pads is not None \
+        else ((1, 1), (1, 1))
+    xp = jnp.pad(x, ((0, 0), (pt, pb), (plft, prgt), (0, 0)))
+    Hp, Wp = H + pt + pb, W + plft + prgt
+    Ho = (Hp - 3) // s + 1
+    Wo = (Wp - 3) // s + 1
+    th = _pick_h_tile(Ho)
+    num_tiles = Ho // th
+    if num_tiles > 16:                        # unroll-depth bound
+        return None
+    slab = s * (th - 1) + 3
+    if s * (num_tiles - 1) * th + slab > Hp:
+        # the last slab would read past the padded input (possible
+        # when padding under-covers the kernel); dense handles it
+        return None
+    out = pl.pallas_call(
+        functools.partial(_conv3x3_kernel, stride=s, th=th,
+                          num_tiles=num_tiles, wo=Wo, relu=relu),
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec((3, 3, Cin, Cout), lambda n: (0, 0, 0, 0)),
+            pl.BlockSpec((1, Cout), lambda n: (0, 0)),
+            pl.BlockSpec((1, Cout), lambda n: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, Ho, Wo, Cout),
+                               lambda n: (n, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, Ho, Wo, Cout), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, slab, Wp, Cin), x.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(xp, w, scale.reshape(1, Cout), shift.reshape(1, Cout))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+def fused_conv_bn_relu(x, w, scale, shift, stride=1, padding=0,
+                       relu=True, interpret=None):
+    """Fused conv+BN+ReLU through the Pallas kernels, NHWC layout.
+
+    x `[N, H, W, Cin]`; w `[kh, kw, Cin, Cout]` (HWIO); scale/shift
+    `[Cout]` — the BatchNorm affine folded to `y = conv(x)*scale +
+    shift` (scale = gamma*rsqrt(var+eps), shift = beta - mean*scale).
+    `padding` accepts ints / pairs / (lo, hi) pairs / "SAME"/"VALID".
+    Forward-only (no VJP): training runs the dense composition via
+    `nn/fused.py`. Off-TPU (or `interpret=True`) the kernels run under
+    the Pallas interpreter — the CPU CI path. Raises ValueError on
+    shapes `conv_shapes_supported` rejects; resolve the backend first
+    (the `nn/fused.py` blocks do) for the clean dense fallback."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    kh, kw = int(w.shape[0]), int(w.shape[1])
+    sh, sw = _pair(stride)
+    pads = normalize_conv_padding(padding, (kh, kw), (sh, sw),
+                                  in_hw=x.shape[1:3])
+    if not conv_shapes_supported((kh, kw), (sh, sw), x.shape[3],
+                                 w.shape[3], padding=pads):
+        raise ValueError(
+            f"fused conv kernels do not cover k={kh}x{kw} s={sh}x{sw} "
+            f"cin={x.shape[3]} cout={w.shape[3]} pad={pads} — resolve "
+            "the backend first and run the dense composition")
+    scale = scale.astype(jnp.float32)
+    shift = shift.astype(jnp.float32)
+    if (kh, kw) == (1, 1):
+        N, H, W, Cin = x.shape
+        if (sh, sw) != (1, 1):
+            x = x[:, ::sh, ::sw]              # exact: SAME k=1 samples
+        Ho, Wo = x.shape[1], x.shape[2]
+        out2 = _conv1x1_call(x.reshape(N * Ho * Wo, Cin), w[0, 0],
+                             scale, shift, relu, interpret)
+        out = out2.reshape(N, Ho, Wo, w.shape[3])
+    else:
+        out = _conv3x3_call(x, w, scale, shift, sh, pads, relu,
+                            interpret)
+        if out is None:
+            raise ValueError(
+                "fused 3x3 kernel cannot tile this geometry "
+                f"(H={x.shape[1]} pad={pads} stride={sh}) — run the "
+                "dense composition")
+    CONV_PATH_STATS["pallas"] += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tpu-verify: contracts + harvest builders
+# ---------------------------------------------------------------------------
+
+# Both kernel families are pure forward programs: nothing donated, no
+# collectives at any mp (TPU104 allows zero by default), weights ride
+# as traced arguments (TPU102), and every tap/row matmul must
+# accumulate fp32 (TPU103 walks the pallas kernel jaxpr — the
+# bf16-input harvest shapes give the rule teeth).
+register_contract(TraceContract(
+    name="conv_bn_relu_1x1",
+    declared_at="paddle_tpu/ops/pallas/conv.py"))
+register_contract(TraceContract(
+    name="conv_bn_relu_3x3",
+    declared_at="paddle_tpu/ops/pallas/conv.py"))
+
+#: (contract name, config, kernel, stride, padding, N, H/W, Cin, Cout)
+#: — tiny-but-structurally-real instances of every kernel family x
+#: stride the suite ships; the asymmetric "SAME" stride-2 3x3 entry
+#: covers the halo/padding geometry the bench sweep runs.
+CONV_HARVEST_SHAPES = (
+    ("conv_bn_relu_1x1", "1x1,s=1", 1, 1, 0, 2, 8, 16, 32),
+    ("conv_bn_relu_1x1", "1x1,s=2", 1, 2, 0, 2, 8, 16, 32),
+    ("conv_bn_relu_3x3", "3x3,s=1", 3, 1, 1, 2, 8, 16, 16),
+    ("conv_bn_relu_3x3", "3x3,s=2", 3, 2, "SAME", 2, 8, 16, 16),
+)
+
+
+def harvest_programs():
+    """-> [(name, config, pure_fn, jitted, args)] for the tpu-verify
+    harvester: one jitted fused-conv program per CONV_HARVEST_SHAPES
+    entry, interpret-mode (the CPU path the gate runs), bf16 inputs so
+    TPU103's narrow-operand accumulation check actually bites."""
+    out = []
+    for name, config, k, s, pad, n, hw, cin, cout in \
+            CONV_HARVEST_SHAPES:
+        pure = functools.partial(fused_conv_bn_relu, stride=s,
+                                 padding=pad, relu=True,
+                                 interpret=True)
+        args = (jnp.zeros((n, hw, hw, cin), jnp.bfloat16),
+                jnp.zeros((k, k, cin, cout), jnp.bfloat16),
+                jnp.ones((cout,), jnp.float32),
+                jnp.zeros((cout,), jnp.float32))
+        out.append((name, config, pure, jax.jit(pure), args))
+    return out

@@ -1,6 +1,16 @@
 """ResNet — analog of python/paddle/vision/models/resnet.py (the
 PaddleClas ResNet-50 benchmark config, BASELINE.md). NCHW, BN layers;
-trains through jit.TrainStep on the MXU in bf16 via amp.auto_cast."""
+trains through jit.TrainStep on the MXU in bf16 via amp.auto_cast.
+
+The residual blocks are built from `nn.ConvBNReLU` (nn/fused.py):
+training forward is byte-for-byte the old conv -> BN -> ReLU
+composition, while EVAL forward can run each conv+BN+ReLU as ONE
+fused Pallas kernel (ops/pallas/conv.py) behind the `conv_backend`
+seam (`auto`/`dense`/`pallas`, env `PADDLE_CONV_BACKEND` wins) —
+the custom conv suite the ResNet MFU plateau called for. The 7x7/s2
+stem keeps the space-to-depth trick and stays a plain conv/BN pair
+(the fused suite covers the 1x1/3x3 bottleneck shapes; the stem
+resolves `dense` cleanly)."""
 from __future__ import annotations
 
 import paddle_tpu.nn as nn
@@ -10,22 +20,22 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 conv_backend=None):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+        self.convbn1 = nn.ConvBNReLU(
+            inplanes, planes, 3, stride=stride, padding=1, act="relu",
+            backend=conv_backend, norm_layer=norm_layer)
+        self.convbn2 = nn.ConvBNReLU(
+            planes, planes, 3, padding=1, act=None,
+            backend=conv_backend, norm_layer=norm_layer)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
+        out = self.convbn2(self.convbn1(x))
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
@@ -35,25 +45,26 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 conv_backend=None):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
-        self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation,
-                               groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+        self.convbn1 = nn.ConvBNReLU(
+            inplanes, width, 1, act="relu", backend=conv_backend,
+            norm_layer=norm_layer)
+        self.convbn2 = nn.ConvBNReLU(
+            width, width, 3, stride=stride, padding=dilation,
+            dilation=dilation, groups=groups, act="relu",
+            backend=conv_backend, norm_layer=norm_layer)
+        self.convbn3 = nn.ConvBNReLU(
+            width, planes * self.expansion, 1, act=None,
+            backend=conv_backend, norm_layer=norm_layer)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
+        out = self.convbn3(self.convbn2(self.convbn1(x)))
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
@@ -61,7 +72,7 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, conv_backend=None):
         super().__init__()
         layer_cfg = {
             18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
@@ -73,6 +84,7 @@ class ResNet(nn.Layer):
         self.num_classes = num_classes
         self.with_pool = with_pool
         self._norm_layer = nn.BatchNorm2D
+        self._conv_backend = conv_backend
         self.inplanes = 64
         self.dilation = 1
 
@@ -92,20 +104,23 @@ class ResNet(nn.Layer):
 
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
+        backend = self._conv_backend
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
-            downsample = nn.Sequential(
-                nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion),
-            )
+            # 1x1/s projection shortcut — also a fused-suite shape
+            downsample = nn.ConvBNReLU(
+                self.inplanes, planes * block.expansion, 1,
+                stride=stride, act=None, backend=backend,
+                norm_layer=norm_layer)
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width, norm_layer=norm_layer)]
+                        self.groups, self.base_width,
+                        norm_layer=norm_layer, conv_backend=backend)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                conv_backend=backend))
         return nn.Sequential(*layers)
 
     def _stem_conv(self, x):
@@ -125,8 +140,8 @@ class ResNet(nn.Layer):
                 or self.conv1._padding != 3
                 or self.conv1.bias is not None):
             # only the canonical 7x7/s2/p3 no-bias stem repacks exactly;
-            # anything else (e.g. a CIFAR-style 3x3 stem swap) runs the
-            # plain conv
+            # anything else (e.g. a CIFAR-style 3x3 stem swap, or the
+            # BN-folded stem with its fused bias) runs the plain conv
             return self.conv1(x)
 
         def fn(a, wt):

@@ -1,0 +1,375 @@
+"""Fused Pallas conv+BN+ReLU suite (ISSUE 14): interpreter-mode
+numeric parity vs the dense `lax.conv_general_dilated` composition
+across the nine ResNet-50 sweep shapes, the stride/ReLU/padding
+matrix, the backend seam (env override, clean stem fallback), the
+ConvBNReLU block + resnet50 wiring, inference-time BN folding, and
+the CI satellites (import smoke, pending bench rows).
+
+Shapes run at reduced batch: the (hw, cin, cout, k, s) tuple is the
+shape CLASS the kernels tile by; batch only scales the grid."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.conv import (
+    CONV_PATH_STATS, conv_bn_relu_reference, conv_shapes_supported,
+    fused_conv_bn_relu, normalize_conv_padding, reset_conv_path_stats,
+    resolve_conv_backend,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the nine ResNet-50 sweep shapes — THE bench_ops table, not a copy
+# (a corrected shape there must flow into these parity tests), run
+# batch-reduced for the CPU interpreter; "SAME" matches the bench
+# rows (asymmetric at stride 2 — the halo edge case rides along)
+import bench_ops
+
+SWEEP = list(bench_ops.CONV_SWEEP_SHAPES)
+assert len(SWEEP) == 9
+
+# stated numeric budgets (README "Pallas conv suite"): fp32 near-exact
+# (only fp32 reduction order differs between the 9-tap implicit GEMM
+# and XLA's conv reduction), bf16 inputs within the bench_ops budget
+FP32_REL_TOL = 1e-5
+BF16_REL_TOL = 0.03
+
+
+def _rel_err(got, ref):
+    g = np.asarray(got, np.float32)
+    r = np.asarray(ref, np.float32)
+    return np.max(np.abs(g - r)) / max(np.max(np.abs(r)), 1e-6)
+
+
+def _case(hw, cin, cout, k, s, dtype, n=1, seed=0, padding="SAME"):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, hw, hw, cin).astype(np.float32)) \
+        .astype(dtype)
+    w = jnp.asarray((rng.randn(k, k, cin, cout) * 0.1)
+                    .astype(np.float32)).astype(dtype)
+    scale = jnp.asarray((rng.rand(cout) + 0.5).astype(np.float32))
+    shift = jnp.asarray(rng.randn(cout).astype(np.float32))
+    return x, w, scale, shift
+
+
+def _check(hw, cin, cout, k, s, dtype, tol, relu=True, n=1,
+           padding="SAME", seed=0):
+    x, w, scale, shift = _case(hw, cin, cout, k, s, dtype, n=n,
+                               seed=seed)
+    got = fused_conv_bn_relu(x, w, scale, shift, stride=s,
+                             padding=padding, relu=relu,
+                             interpret=True)
+    ref = conv_bn_relu_reference(x, w, scale, shift, stride=s,
+                                 padding=padding, relu=relu)
+    assert got.shape == ref.shape
+    err = _rel_err(got, ref)
+    assert err <= tol, f"rel err {err:.2e} > {tol}"
+    return got
+
+
+@pytest.mark.parametrize("name,hw,cin,cout,k,s", SWEEP,
+                         ids=[r[0] for r in SWEEP])
+def test_sweep_shape_parity_fp32(name, hw, cin, cout, k, s):
+    """Acceptance: every sweep shape, fused vs the dense composition,
+    fp32 under the CPU interpreter."""
+    _check(hw, cin, cout, k, s, jnp.float32, FP32_REL_TOL)
+
+
+@pytest.mark.parametrize("name,hw,cin,cout,k,s", SWEEP,
+                         ids=[r[0] for r in SWEEP])
+def test_sweep_shape_parity_bf16(name, hw, cin, cout, k, s):
+    """bf16 inputs / fp32 accumulation, within the stated budget."""
+    _check(hw, cin, cout, k, s, jnp.bfloat16, BF16_REL_TOL)
+
+
+@pytest.mark.parametrize("k,cin,cout", [(1, 32, 64), (3, 32, 32)])
+@pytest.mark.parametrize("s", [1, 2])
+@pytest.mark.parametrize("relu", [True, False])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_stride_relu_dtype_matrix(k, cin, cout, s, relu, dtype):
+    """Both kernel families x stride {1,2} x {with,without ReLU} x
+    {fp32, bf16} at a small shape — the cross product the sweep rows
+    fix at their native stride."""
+    tol = FP32_REL_TOL if dtype == jnp.float32 else BF16_REL_TOL
+    _check(16, cin, cout, k, s, dtype, tol, relu=relu, n=2)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,hw,cin,cout,k,s", SWEEP,
+                         ids=[r[0] for r in SWEEP])
+@pytest.mark.parametrize("relu", [True, False])
+def test_sweep_full_stride_matrix(name, hw, cin, cout, k, s, relu):
+    """The full sweep x stride x ReLU cross product (3x3 shapes at
+    both strides; 1x1 at stride 2 exercises the downsample slice)."""
+    for stride in (1, 2):
+        if k == 1 and stride == 2 and hw % 2:
+            continue
+        _check(hw, cin, cout, k, stride, jnp.float32, FP32_REL_TOL,
+               relu=relu)
+
+
+def test_padding_conventions_and_halos():
+    """Symmetric paddle padding=1 vs asymmetric "SAME" at stride 2
+    sample DIFFERENT input grids — both must match the dense foil
+    (the border-halo rows/cols are where a wrong slab DMA shows)."""
+    for padding in (1, "SAME", ((1, 1), (1, 1)), ((0, 1), (0, 1))):
+        _check(14, 16, 16, 3, 2, jnp.float32, FP32_REL_TOL,
+               padding=padding)
+    # tiny image: every output pixel touches the halo
+    _check(4, 16, 16, 3, 1, jnp.float32, FP32_REL_TOL, padding=1)
+    assert normalize_conv_padding("SAME", (3, 3), (2, 2),
+                                  in_hw=(56, 56)) == ((0, 1), (0, 1))
+    assert normalize_conv_padding(1, (3, 3), (1, 1)) == ((1, 1), (1, 1))
+
+
+def test_odd_row_count_pads_matmul_tile():
+    """M = N*Ho*Wo with no pow2 divisor (the c5 7x7 grid at small
+    batch) rides the zero-padded row tile and slices back exactly."""
+    _check(7, 16, 24, 1, 1, jnp.float32, FP32_REL_TOL, n=2)
+
+
+def test_unsupported_shapes_rejected_and_resolve_falls_back():
+    """The 7x7/s2 stem (and grouped/dilated/ragged-channel convs)
+    resolve `dense` cleanly whatever backend was requested; calling
+    the kernel directly on such a shape is a loud ValueError."""
+    assert not conv_shapes_supported((7, 7), (2, 2), 3, 64)
+    assert not conv_shapes_supported((3, 3), (1, 1), 60, 64)
+    assert not conv_shapes_supported((3, 3), (1, 1), 64, 64, groups=2)
+    assert not conv_shapes_supported((3, 3), (1, 1), 64, 64,
+                                     dilation=2)
+    assert not conv_shapes_supported((3, 3), (3, 3), 64, 64)
+    assert not conv_shapes_supported((1, 1), (1, 1), 64, 64,
+                                     padding=1)
+    assert conv_shapes_supported((1, 1), (2, 2), 64, 256)
+    assert resolve_conv_backend("pallas", kernel=(7, 7), stride=(2, 2),
+                                in_channels=3, out_channels=64,
+                                padding=3) == "dense"
+    with pytest.raises(ValueError, match="dense composition"):
+        x = jnp.zeros((1, 16, 16, 3))
+        w = jnp.zeros((7, 7, 3, 64))
+        fused_conv_bn_relu(x, w, jnp.ones(64), jnp.zeros(64),
+                           stride=2, padding=3, interpret=True)
+    with pytest.raises(ValueError, match="backend"):
+        resolve_conv_backend("mxu")
+
+
+def test_untileable_geometry_falls_back_dense_at_forward():
+    """Code-review regression: a resolved-pallas block hitting a 3x3
+    geometry the kernel cannot tile (here 17 row tiles > the unroll
+    bound) must run the dense composition at forward — never raise
+    mid-model — and the dense dispatch must be counted (the
+    'never a silent fallback' contract covers BOTH paths)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.ops.pallas.conv import conv_geometry_tileable
+
+    assert not conv_geometry_tileable(3, 1, 1, in_hw=(34, 34))
+    assert conv_geometry_tileable(3, 1, 1, in_hw=(32, 32))
+    assert conv_geometry_tileable(1, 1, 0, in_hw=(34, 34))
+
+    paddle.seed(0)
+    blk_p = nn.ConvBNReLU(8, 8, 3, padding=1, backend="pallas")
+    paddle.seed(0)
+    blk_d = nn.ConvBNReLU(8, 8, 3, padding=1, backend="dense")
+    blk_p.eval()
+    blk_d.eval()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 8, 34, 34).astype(np.float32))
+    reset_conv_path_stats()
+    out = blk_p(x)                        # must not raise
+    assert CONV_PATH_STATS == {"dense": 1, "pallas": 0}
+    np.testing.assert_array_equal(out.numpy(), blk_d(x).numpy())
+
+
+def test_backend_env_override_wins(monkeypatch):
+    """PADDLE_CONV_BACKEND beats the constructor argument (deploy
+    semantics, the paged-attention seam contract) — both directions —
+    and resolution happens ONCE at construction."""
+    import paddle_tpu.nn as nn
+
+    monkeypatch.setenv("PADDLE_CONV_BACKEND", "dense")
+    blk = nn.ConvBNReLU(16, 16, 3, padding=1, backend="pallas")
+    assert blk.backend == "dense"
+    monkeypatch.setenv("PADDLE_CONV_BACKEND", "pallas")
+    blk = nn.ConvBNReLU(16, 16, 3, padding=1, backend="dense")
+    assert blk.backend == "pallas"
+    monkeypatch.delenv("PADDLE_CONV_BACKEND")
+    assert nn.ConvBNReLU(16, 16, 3, padding=1).backend == "dense"  # auto, CPU
+    # the stem shape falls back whatever the env says
+    monkeypatch.setenv("PADDLE_CONV_BACKEND", "pallas")
+    stem = nn.ConvBNReLU(3, 64, 7, stride=2, padding=3)
+    assert stem.backend == "dense"
+
+
+def test_convbnrelu_block_parity_and_training_path():
+    """The block contract: eval forward fused == dense composition
+    within budget; train forward IS the composition bit-for-bit (the
+    fused path must never engage in training); gradients flow."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    blk_p = nn.ConvBNReLU(16, 32, 3, padding=1, backend="pallas")
+    paddle.seed(0)
+    blk_d = nn.ConvBNReLU(16, 32, 3, padding=1, backend="dense")
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 16, 8, 8).astype(np.float32))
+    blk_p.eval()
+    blk_d.eval()
+    reset_conv_path_stats()
+    out_p = blk_p(x)
+    assert CONV_PATH_STATS["pallas"] == 1
+    out_d = blk_d(x)
+    assert _rel_err(out_p.numpy(), out_d.numpy()) <= FP32_REL_TOL
+    assert out_p.stop_gradient      # fused path is forward-only
+
+    # train mode: BOTH backends run the identical composition
+    blk_p.train()
+    blk_d.train()
+    reset_conv_path_stats()
+    t_p = blk_p(x)
+    assert CONV_PATH_STATS["pallas"] == 0, \
+        "fused kernel must not engage in training mode"
+    t_d = blk_d(x)
+    np.testing.assert_array_equal(t_p.numpy(), t_d.numpy())
+    loss = (t_p * t_p).mean()
+    loss.backward()
+    assert blk_p.conv.weight.grad is not None
+    # act=None block (the bn3/downsample shape)
+    blk = nn.ConvBNReLU(16, 16, 1, act=None, backend="pallas")
+    blk.eval()
+    out = blk(x)
+    assert float(out.min()) < 0  # no ReLU applied
+    with pytest.raises(ValueError, match="act"):
+        nn.ConvBNReLU(8, 8, 3, act="gelu")
+
+
+def test_resnet50_forward_uses_fused_seam():
+    """Acceptance: resnet50 eval forward through the fused backend
+    matches the dense backend, with every bottleneck conv dispatching
+    through the Pallas kernels (the stem stays dense by design)."""
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    m_d = resnet50(num_classes=10)
+    paddle.seed(0)
+    m_p = resnet50(num_classes=10, conv_backend="pallas")
+    m_d.eval()
+    m_p.eval()
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .uniform(-1, 1, (2, 3, 32, 32))
+                         .astype(np.float32))
+    ref = m_d(x).numpy()
+    reset_conv_path_stats()
+    got = m_p(x).numpy()
+    # 16 blocks x 3 convs + 4 downsamples = 52 fused dispatches
+    assert CONV_PATH_STATS["pallas"] == 52
+    assert _rel_err(got, ref) <= 1e-4
+
+
+def test_bn_folding_exact_on_resnet50_eval():
+    """ISSUE satellite: fold BatchNorm into conv weights/bias for eval
+    and prove the resnet50 eval forward unchanged (up to the one
+    folded-weight rounding)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    m = resnet50(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .uniform(-1, 1, (2, 3, 32, 32))
+                         .astype(np.float32))
+    ref = m(x).numpy()
+    folded = nn.fuse_conv_bn(m)
+    # 16 blocks x 3 + 4 downsamples + the stem conv1/bn1 pair
+    assert folded == 53
+    got = m(x).numpy()
+    assert _rel_err(got, ref) <= 1e-5
+    # idempotent: a second pass finds nothing left to fold
+    assert nn.fuse_conv_bn(m) == 0
+
+
+def test_fold_bn_into_conv_with_existing_bias():
+    """Folding must scale a pre-existing conv bias into the shift."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    conv = nn.Conv2D(8, 8, 3, padding=1)          # bias ON
+    bn = nn.BatchNorm2D(8)
+    bn._mean.set_value(np.random.RandomState(3).randn(8)
+                       .astype(np.float32))
+    bn._variance.set_value((np.random.RandomState(4).rand(8) + 0.5)
+                           .astype(np.float32))
+    conv.eval()
+    bn.eval()
+    x = paddle.to_tensor(np.random.RandomState(5)
+                         .randn(2, 8, 8, 8).astype(np.float32))
+    ref = bn(conv(x)).numpy()
+    nn.fold_bn_into_conv(conv, bn)
+    got = conv(x).numpy()
+    assert _rel_err(got, ref) <= 1e-5
+
+
+def test_conv_kernel_import_has_no_backend_init():
+    """Importing the kernel module must not initialize a JAX backend
+    (the paged-attention smoke precedent): nn/fused.py imports it at
+    block construction on serving hosts."""
+    code = (
+        "import paddle_tpu.ops.pallas.conv as ck\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, 'backend initialized'\n"
+        "assert callable(ck.fused_conv_bn_relu)\n"
+        "assert ck.resolve_conv_backend('dense') == 'dense'\n"
+        "print('SMOKE_OK')\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PADDLE_CONV_BACKEND", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "SMOKE_OK" in res.stdout
+
+
+def test_new_bench_rows_registered_and_pending():
+    """Both ISSUE-14 rows are in the suite (so a TPU run measures
+    them) and stay --pending until a `--save` refresh adopts them."""
+    import bench_ops
+
+    names = bench_ops.suite_names()
+    assert "conv_fused_sweep" in names
+    assert "resnet50_fused_block" in names
+
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "check_bench_result.py"),
+         "--pending", os.path.join(REPO, "OPBENCH.json")],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "PENDING: conv_fused_sweep" in res.stdout
+    assert "PENDING: resnet50_fused_block" in res.stdout
+
+
+def test_bench_runners_tiny():
+    """Both lazy bench runners execute end-to-end at tiny shapes with
+    their in-runner tolerance asserts live."""
+    import bench_ops
+
+    rec = bench_ops._conv_fused_sweep_case(
+        shapes=(("conv_c2_1x1_64_256", 8, 16, 32, 1, 1),
+                ("conv_c4_3x3_256_s2", 8, 16, 16, 3, 2)), batch=2)()
+    assert set(rec["shapes"]) == {"conv_c2_1x1_64_256",
+                                  "conv_c4_3x3_256_s2"}
+    for curves in rec["shapes"].values():
+        assert curves["rel_err"] <= bench_ops.CONV_FUSED_REL_TOL
+    rec = bench_ops._resnet50_fused_block_case(batch=2, hw=8,
+                                               inplanes=32, planes=8)()
+    assert rec["rel_err"] <= bench_ops.CONV_FUSED_REL_TOL
+    assert rec["dense_ms"] > 0 and rec["ms"] > 0

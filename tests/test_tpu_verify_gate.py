@@ -71,14 +71,18 @@ def test_matrix_is_contract_clean(matrix_result):
     # per-block-scaled KV pools + int8 weights) — plus the 4 PR-13
     # adapter-threaded programs (LORA_CONFIGS: a plain fp mp=1
     # decode + both prefills, and the composed
-    # pallas/K=4/mp=2/int8 verify step)
-    assert len(res.programs) == 32
+    # pallas/K=4/mp=2/int8 verify step) — plus the 4 PR-14 fused
+    # Pallas conv programs (both kernel families x stride)
+    assert len(res.programs) == 36
     assert sum(",int8" in p.config for p in res.programs) == 15
     assert sum(",lora" in p.config for p in res.programs) == 4
+    assert sum(p.contract.name.startswith("conv_bn_relu")
+               for p in res.programs) == 4
     names = {p.contract.name for p in res.programs}
     assert names == {"engine_decode_step", "engine_verify_step",
                      "engine_prefill", "engine_prefill_chunk",
-                     "engine_cow_copy"}
+                     "engine_cow_copy", "conv_bn_relu_1x1",
+                     "conv_bn_relu_3x3"}
     assert res.stale_trace_baseline == []
 
 
@@ -229,4 +233,4 @@ def test_cli_acceptance_command_exits_zero():
         [sys.executable, os.path.join(REPO, "tools", "tpu_verify.py")],
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "tpu-verify clean: 32 programs" in res.stdout
+    assert "tpu-verify clean: 36 programs" in res.stdout

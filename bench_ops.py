@@ -105,8 +105,8 @@ SUITE_ROWS = (
     "gpt_engine_prefix_cache", "gpt_engine_chunked_prefill",
     "gpt_engine_speculative", "gpt_engine_offered_load_mp2",
     "gpt_engine_offered_load_int8", "gpt_fleet_offered_load",
-    "gpt_engine_multitenant_lora", "conv_fused_sweep",
-    "resnet50_fused_block",
+    "gpt_engine_multitenant_lora", "gpt_engine_sampling",
+    "conv_fused_sweep", "resnet50_fused_block",
 )
 
 
@@ -213,6 +213,7 @@ def suite():
     cases["gpt_fleet_offered_load"] = _fleet_offered_load_case()
     cases["gpt_engine_multitenant_lora"] = \
         _engine_multitenant_lora_case()
+    cases["gpt_engine_sampling"] = _engine_sampling_case()
     cases["conv_fused_sweep"] = _conv_fused_sweep_case()
     cases["resnet50_fused_block"] = _resnet50_fused_block_case()
     # every suite() caller trips on drift immediately, not just the one
@@ -1356,6 +1357,121 @@ def _engine_speculative_case(model_cfg=None, num_requests=12,
                 "verify_steps": int(fam["count"]),
                 "decode_recompiles": int(series_total(
                     snap, "engine_decode_recompiles_total")),
+                "requests": num_requests}
+
+    return run_bench
+
+
+def _engine_sampling_case(model_cfg=None, num_requests=12,
+                          num_slots=4, block_size=16, max_new=32,
+                          best_n=4, seed=0):
+    """Probabilistic-serving row (ISSUE 15): the offered-load trace
+    served three ways on one sampling-enabled engine over one model —
+    greedy (temperature 0, asserted TOKEN-IDENTICAL to a sampling-OFF
+    engine: the bit-exact no-regression contract at bench scale),
+    temperature 0.8 sampled (same fixed seeds served twice, asserted
+    reproducible token-for-token), and a best-of-`best_n` fan-out of
+    one prompt (asserted to seat the shared prompt blocks ONCE via the
+    prefix-hit counter). The tracked numbers are tokens/s for all
+    three modes — the cost of the on-device masking+draw relative to
+    the pure-argmax step — plus the sampled-token and prefix-hit
+    counters. On TPU the overhead is the headline; CPU CI only asserts
+    structure."""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.inference import (GenerationEngine,
+                                          SamplingParams)
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        from paddle_tpu.observability.metrics import series_total
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        # prompt + budget must fit the model window (tiny CI configs)
+        hi = min(97, cfg.max_seq_len - max_new)
+        lo = min(16, hi - 1)
+        reqs = [rng.randint(0, cfg.vocab_size,
+                            rng.randint(lo, hi)).astype(np.int32)
+                for _ in range(num_requests)]
+        model = GPTForCausalLM(cfg)
+        model.eval()
+
+        def build(on):
+            engine = GenerationEngine(model, num_slots=num_slots,
+                                      block_size=block_size,
+                                      sampling=on)
+            if engine.sampling != on:
+                # a row comparing sampling-on against sampling-off
+                # must never record an env-overridden engine's
+                # numbers under either name
+                raise RuntimeError(
+                    f"bench row requested sampling={on} but the "
+                    f"engine resolved {engine.sampling} (is "
+                    "PADDLE_SERVE_SAMPLING set?) — unset it to run "
+                    "this row")
+            return engine
+
+        def serve(engine, params_of):
+            engine.add_request(reqs[0], 2)     # compile warmup
+            engine.run()
+            engine.metrics.reset()
+            base = engine.tokens_generated
+            t0 = time.perf_counter()
+            ids = [engine.add_request(p, max_new_tokens=max_new,
+                                      sampling_params=params_of(i))
+                   for i, p in enumerate(reqs)]
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            toks = engine.tokens_generated - base
+            assert len(out) == num_requests
+            return dt, toks, [out[r] for r in ids]
+
+        ref = build(False)
+        dt_ref, toks_ref, outs_ref = serve(ref, lambda i: None)
+        eng = build(True)
+        dt_g, toks_g, outs_g = serve(eng, lambda i: None)
+        assert outs_g == outs_ref, \
+            "temperature-0 serving diverged from the sampling-off " \
+            "engine (the bit-exact greedy contract)"
+        sp = lambda i: SamplingParams(temperature=0.8, top_k=50,
+                                      top_p=0.95, seed=seed + i)
+        eng_s = build(True)
+        dt_s, toks_s, outs_s = serve(eng_s, sp)
+        _, _, outs_s2 = serve(build(True), sp)
+        assert outs_s == outs_s2, \
+            "same-seed sampled serving is not reproducible"
+        snap = eng_s.metrics_snapshot()
+        sampled = int(series_total(snap,
+                                   "engine_sampled_tokens_total"))
+        bo = build(True)
+        hit0 = bo.prefix_hit_tokens
+        t0 = time.perf_counter()
+        cands = bo.best_of_n(reqs[0], best_n, max_new,
+                             sampling_params=SamplingParams(
+                                 temperature=0.8, seed=seed))
+        dt_b = time.perf_counter() - t0
+        shared = (len(reqs[0]) // block_size) * block_size
+        assert bo.prefix_hit_tokens - hit0 == (best_n - 1) * shared, \
+            "best_of_n did not seat the shared prompt blocks once"
+        toks_b = sum(len(c) - len(reqs[0]) for c in cands)
+        return {"ms": round(dt_s * 1e3, 1),
+                "tokens_per_s_greedy_off": round(toks_ref / dt_ref),
+                "tokens_per_s_greedy": round(toks_g / dt_g),
+                "tokens_per_s_sampled": round(toks_s / dt_s),
+                "sampling_overhead_vs_off": round(
+                    (toks_ref / dt_ref) / max(toks_s / dt_s, 1e-9),
+                    3),
+                "tokens_per_s_best_of_n": round(toks_b / dt_b),
+                "best_n": best_n,
+                "sampled_tokens": sampled,
+                "best_of_n_hit_tokens": int(
+                    bo.prefix_hit_tokens - hit0),
                 "requests": num_requests}
 
     return run_bench

@@ -58,6 +58,20 @@ KV_DTYPES = (None, "int8")
 LORA_CONFIGS = (("dense", 0, 1, None, True),
                 ("pallas", 4, 2, "int8", True))
 
+#: Probabilistic serving configs (PR 15): the base matrix threads NO
+#: sampling state (a sampling=False engine's programs must stay
+#: byte-identical to the pre-sampling baseline — the greedy
+#: no-regression proof at the trace level), and these two extra
+#: configs prove the sampling-threaded steps — a plain fp mp=1
+#: decode+prefill pass and the fully-composed (pallas, K=4, mp=2,
+#: int8) REJECTION-SAMPLING verify step — under every TPU1xx rule:
+#: donation still pins both pools, the draw/masking math stays fp32
+#: (TPU103), and the per-slot key folds add NO collectives (TPU104's
+#: budget is unchanged — the draws run replicated on the all-gathered
+#: logits).
+SAMPLING_CONFIGS = (("dense", 0, 1, None, False, True),
+                    ("pallas", 4, 2, "int8", False, True))
+
 #: Tiny-but-structurally-real harvest geometry: 2 layers so per-layer
 #: collective budgets multiply, 4 heads so mp=2 head-sharding divides,
 #: block_size 8 so the pallas kernel's sublane constraint holds.
@@ -66,9 +80,10 @@ TINY = dict(vocab=64, hidden=32, layers=2, heads=4, seq=32,
 
 
 def default_matrix():
-    return tuple((b, k, mp, kv, False) for b in BACKENDS
+    return tuple((b, k, mp, kv, False, False) for b in BACKENDS
                  for k in SPEC_KS for mp in MP_DEGREES
-                 for kv in KV_DTYPES) + LORA_CONFIGS
+                 for kv in KV_DTYPES) \
+        + tuple((*m, False) for m in LORA_CONFIGS) + SAMPLING_CONFIGS
 
 
 def _require_devices(mp):
@@ -145,7 +160,10 @@ def harvest(matrix=None):
     serve int8 per-block-scaled KV AND int8 weights — the full
     quantized serving shape. The LORA_CONFIGS entries add the
     adapter-threaded programs (4 more: a dense mp=1 decode + both
-    prefills, and the composed pallas/K=4/mp=2/int8 verify). The
+    prefills, and the composed pallas/K=4/mp=2/int8 verify); the
+    SAMPLING_CONFIGS entries add the sampling-threaded programs
+    (4 more: a dense mp=1 sampled decode + both sampled prefills, and
+    the composed pallas/K=4/mp=2/int8 REJECTION-SAMPLING verify). The
     default (full) harvest also carries the fused Pallas conv suite's
     4 programs (`_conv_programs`) so their lowering is drift-gated
     like every engine step."""
@@ -155,20 +173,25 @@ def harvest(matrix=None):
     from paddle_tpu.inference.engine import GenerationEngine
 
     include_conv = matrix is None
+    # pad short (pre-sampling / pre-lora) matrix entries with the
+    # DEFAULTS for the missing trailing fields — positional slicing
+    # would hand a 5-tuple samp=None and trip check_knobs
     matrix = default_matrix() if matrix is None else tuple(
-        (*m, None, False)[:5] if len(m) < 5 else m for m in matrix)
-    for _, _, mp, _, _ in matrix:
+        (*m, *(None, False, False)[len(m) - 3:]) if len(m) < 6 else m
+        for m in matrix)
+    for _, _, mp, _, _, _ in matrix:
         _require_devices(mp)
     model = _build_model()
     L = model.config.num_layers
     programs = []
 
-    def check_knobs(engine, kv):
+    def check_knobs(engine, kv, samp=False):
         # serve-time env overrides win over ctor args by design — but
         # a leaked PADDLE_SERVE_KV_DTYPE/PADDLE_SERVE_WEIGHT_DTYPE
-        # would silently harvest (and baseline) a quantized program
-        # under an fp config label, or feed fp-shaped step args to a
-        # quantized signature. Fail loudly instead.
+        # (or PADDLE_SERVE_SAMPLING) would silently harvest (and
+        # baseline) a quantized/sampling program under the wrong
+        # config label, or feed wrong-shaped step args to the
+        # signature. Fail loudly instead.
         if (engine.kv_dtype, engine.weight_dtype) != (kv, kv):
             raise RuntimeError(
                 f"harvest config kv={kv!r} resolved kv_dtype="
@@ -176,21 +199,36 @@ def harvest(matrix=None):
                 f"{engine.weight_dtype!r} (is PADDLE_SERVE_KV_DTYPE "
                 "or PADDLE_SERVE_WEIGHT_DTYPE set?) — unset them to "
                 "harvest")
+        if engine.sampling != samp:
+            raise RuntimeError(
+                f"harvest config sampling={samp!r} resolved "
+                f"{engine.sampling!r} (is PADDLE_SERVE_SAMPLING "
+                "set?) — unset it to harvest")
         return engine
 
+    def samp_rows(n):
+        """The four traced sampling rows of an n-slot dispatch —
+        the engine's host-arg layout, reproduced exactly."""
+        return (jnp.asarray(np.zeros(n, np.float32)),
+                jnp.asarray(np.zeros(n, np.int32)),
+                jnp.asarray(np.ones(n, np.float32)),
+                jnp.asarray(np.zeros((n, 2), np.uint32)))
+
     registry = None
-    for backend, K, mp, kv, lora in matrix:
-        tag = (",int8" if kv else "") + (",lora" if lora else "")
+    for backend, K, mp, kv, lora, samp in matrix:
+        tag = (",int8" if kv else "") + (",lora" if lora else "") \
+            + (",sampling" if samp else "")
         config = f"{backend},K={K},mp={mp}{tag}"
         quant = dict(kv_dtype=kv, weight_dtype=kv) if kv else {}
         if lora and registry is None:
             registry = _build_registry(model.config)
         adapt = dict(adapters=registry) if lora else {}
+        skw = dict(sampling=True) if samp else {}
         eng = check_knobs(GenerationEngine(
             model, num_slots=TINY["slots"],
             block_size=TINY["block_size"], attention_backend=backend,
             spec_decode_k=K, mp_degree=mp, donate=True, **quant,
-            **adapt), kv)
+            **adapt, **skw), kv, samp)
         S, MB, C = eng.num_slots, eng.max_blocks, eng.prefill_chunk
         state = eng._state_arrays()
         kp, vp = eng.cache.kpool, eng.cache.vpool
@@ -200,17 +238,20 @@ def harvest(matrix=None):
         # engine's _dispatch_step layout, reproduced exactly
         lp = (eng.adapter_pool.arrays(),) if lora else ()
         arow = (jnp.asarray(np.zeros(S, np.int32)),) if lora else ()
+        # probabilistic serving: the temp/top-k/top-p + key rows ride
+        # between the tables and the adapter page row
+        srows = samp_rows(S) if samp else ()
         tokens = jnp.asarray(np.zeros((S, K + 1), np.int32))
         positions = jnp.asarray(np.zeros(S, np.int32))
         tables = jnp.asarray(np.zeros((S, MB), np.int32))
         if K > 0:
             dlens = jnp.asarray(np.zeros(S, np.int32))
             step_args = (state, kp, vp, *sc, *lp, tokens, positions,
-                         dlens, tables, *arow)
+                         dlens, tables, *srows, *arow)
             step_name = "engine_verify_step"
         else:
             step_args = (state, kp, vp, *sc, *lp, tokens, positions,
-                         tables, *arow)
+                         tables, *srows, *arow)
             step_name = "engine_decode_step"
         programs.append(_trace_one(
             step_name, config, eng._decode_pure, eng._decode,
@@ -225,13 +266,15 @@ def harvest(matrix=None):
         if K == 0 and backend == "dense":
             arow1 = (jnp.asarray(np.zeros(1, np.int32)),) if lora \
                 else ()
+            srows1 = samp_rows(1) if samp else ()
             chunk_tokens = jnp.asarray(np.zeros((1, C), np.int32))
             row = jnp.asarray(np.zeros(MB, np.int32))
             programs.append(_trace_one(
                 "engine_prefill_chunk", f"mp={mp}{tag}",
                 eng._prefill_pure, eng._prefill,
                 (state, kp, vp, *sc, *lp, chunk_tokens, jnp.int32(0),
-                 jnp.int32(TINY["block_size"] + 1), row, *arow1),
+                 jnp.int32(TINY["block_size"] + 1), row, *srows1,
+                 *arow1),
                 mp, L))
             bucket = TINY["seq"] // 2
             beng = check_knobs(GenerationEngine(
@@ -239,7 +282,7 @@ def harvest(matrix=None):
                 block_size=TINY["block_size"],
                 attention_backend=backend,
                 prefill_buckets=(bucket, TINY["seq"]), mp_degree=mp,
-                donate=True, **quant, **adapt), kv)
+                donate=True, **quant, **adapt, **skw), kv, samp)
             btok = jnp.asarray(np.zeros((1, bucket), np.int32))
             # every arg from the BUCKETED engine itself — if its
             # geometry/state layout ever diverges from the chunked
@@ -253,9 +296,11 @@ def harvest(matrix=None):
                 beng._prefill,
                 (beng._state_arrays(), beng.cache.kpool,
                  beng.cache.vpool, *bsc, *blp, btok,
-                 jnp.int32(bucket - 2), brow, *arow1),
+                 jnp.int32(bucket - 2), brow, *srows1, *arow1),
                 mp, L))
-            if not lora:
+            if not lora and not samp:
+                # the COW copy is adapter- AND sampling-oblivious:
+                # both config families skip it (no duplicate entry)
                 cow_args = (kp, vp, jnp.int32(1), jnp.int32(2), *sc)
                 programs.append(_trace_one(
                     "engine_cow_copy", f"mp={mp}{tag}", eng._cow_pure,

@@ -23,12 +23,14 @@ from paddle_tpu.inference.engine import (PRIORITY_CLASSES,
                                          GenerationEngine, PagedKVCache,
                                          Request, prefix_key)
 from paddle_tpu.inference.fleet import REPLICA_ROLES, ServingFleet
-from paddle_tpu.inference.speculative import NgramDrafter
+from paddle_tpu.inference.sampling import SamplingParams
+from paddle_tpu.inference.speculative import GptDrafter, NgramDrafter
 
 __all__ = ["Config", "Predictor", "create_predictor", "DistModel",
            "DistModelConfig", "GenerationEngine", "PagedKVCache",
-           "Request", "PRIORITY_CLASSES", "NgramDrafter",
-           "ServingFleet", "REPLICA_ROLES", "prefix_key"]
+           "Request", "PRIORITY_CLASSES", "NgramDrafter", "GptDrafter",
+           "ServingFleet", "REPLICA_ROLES", "prefix_key",
+           "SamplingParams"]
 
 
 def _stream_micro_batches(forward, ins, mbs, pad_to=1):

@@ -149,6 +149,30 @@ model, mp>1 shards the B pages column-parallel (no new collectives,
 bit-identical across mesh shapes), and int8 KV/weights quantize the
 BASE path while adapters ride fp.
 
+Probabilistic serving (PR 15): `GenerationEngine(sampling=True)` (env
+`PADDLE_SERVE_SAMPLING`) turns on per-request on-device sampling —
+`add_request(..., sampling_params=SamplingParams(temperature, top_k,
+top_p, seed))` carries each request's knobs PER SLOT through the
+fixed-shape decode and verify steps as traced per-row arrays (params
+are data, never trace keys: `decode_traces == 1` holds per
+(backend, K, mp, kv_dtype) for any live mix of greedy and sampled
+lanes). Each sampled slot owns a `[2]` uint32 base key row derived
+from its seed; every draw folds the slot's absolute position (plus a
+draw-purpose salt) into it on device (`ops/sampling.py`), so same
+(seed, trace, config) means same tokens across prefill modes, cache
+states and backends — while greedy lanes (`temperature=0`, and every
+lane of a `sampling=False` engine, whose programs are byte-identical
+to the pre-sampling ones) keep taking the literal argmax. With
+speculation on, acceptance upgrades from exact argmax equality to
+Leviathan-style REJECTION SAMPLING at the verify step: all K+1 logit
+positions are already in hand, so the compiled program computes
+per-row accept coins and residual/bonus resamples in the same pass,
+and the host walk emits `drafts[:n] + choices[n]` — provably
+preserving the target distribution for any (deterministic) drafter,
+and degenerating to the bit-exact greedy contract at temperature 0.
+`best_of_n` fans one prompt into n sampled lanes that share its
+prefix-cache blocks (seated once, read-only).
+
 Serving telemetry (PR 2): every engine carries a metrics registry
 (`engine.metrics`, observability tier) — TTFT/TPOT histograms, queue/
 slot/pool gauges with a high-water mark, admission/finish/stall
@@ -175,6 +199,8 @@ import jax.numpy as jnp
 from paddle_tpu.analysis.trace.contracts import TraceContract, \
     register_contract
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.inference.sampling import SamplingParams
+from paddle_tpu.inference.sampling import key_row as _sampling_key_row
 from paddle_tpu.jit import introspect
 from paddle_tpu.jit.api import bound_state, count_traces, dedup_params, \
     model_buffers
@@ -183,7 +209,8 @@ from paddle_tpu.observability.metrics import LATENCY_BUCKETS, \
 from paddle_tpu.profiler import RecordEvent
 
 __all__ = ["PagedKVCache", "GenerationEngine", "Request",
-           "PRIORITY_CLASSES", "prefix_key", "iter_prefix_key"]
+           "PRIORITY_CLASSES", "prefix_key", "iter_prefix_key",
+           "SamplingParams"]
 
 
 def iter_prefix_key(tokens, block_size, adapter_id=0):
@@ -224,6 +251,65 @@ def prefix_key(tokens, block_size, adapter_id=0):
     whose cache owns the deepest digest of its prompt) — factored out
     so the two can never drift: a router key IS a cache key."""
     return tuple(iter_prefix_key(tokens, block_size, adapter_id))
+
+
+def _best_of_n_intake(eng, sampling_params, n, counter):
+    """Shared best-of-n validation + None-seed RANGE claim (engine and
+    fleet editions both run this, so the checks and the seed-claim
+    invariant can never drift between them). `eng` is the serving
+    engine (any fleet replica — they're homogeneous), `counter` the
+    caller's deterministic seed counter. Returns (params, base,
+    advanced counter); advancing by one instead of n would hand seeds
+    base+1..base+n-1 out again to later None-seed requests, replaying
+    candidates."""
+    if n < 1:
+        raise ValueError(f"need n >= 1 candidates, got {n}")
+    if not eng.sampling:
+        raise ValueError(
+            "best_of_n needs sampling=True engines "
+            "(GenerationEngine(sampling=True); fleets pass it in "
+            "engine_options) — n greedy lanes would be n identical "
+            "candidates")
+    if not eng.enable_prefix_cache:
+        raise ValueError(
+            "best_of_n needs the prefix cache (chunked prefill) — "
+            "without it every candidate re-prefills the prompt")
+    params = eng._check_sampling(
+        sampling_params if sampling_params is not None
+        else SamplingParams())
+    if params.greedy:
+        raise ValueError(
+            "best_of_n needs temperature > 0 — greedy candidates "
+            "would all be the same continuation")
+    if params.seed is None:
+        return params, counter, counter + int(n)
+    return params, params.seed, counter
+
+
+def _best_of_n_fanout(add, run, params, n, base):
+    """The shared best-of-n candidate loop (engine AND fleet edition
+    call this, so the fan-out protocol can never drift between them):
+    candidate 0 is served to completion FIRST — its prefill writes and
+    registers the prompt's full blocks once — then candidates 1..n-1
+    admit against the warm prefix, seeds `base..base+n-1`. Returns
+    (candidates in seed order, bystander finishes the two run() calls
+    collected along the way)."""
+    ids = [add(params.with_seed(base))]
+    stash = run()
+    for i in range(1, int(n)):
+        ids.append(add(params.with_seed(base + i)))
+    stash.update(run())
+    out = [stash.pop(i) for i in ids]
+    if any(c is None for c in out):
+        # a candidate was load-shed at admission (max_queue pressure
+        # with no lower-priority victim) — a silent None in the
+        # returned list would violate the n-candidates contract
+        raise RuntimeError(
+            f"best_of_n: {sum(c is None for c in out)} of {n} "
+            "candidates were shed at admission under max_queue "
+            "pressure — serve best_of_n with queue headroom for n "
+            "candidates (or raise max_queue)")
+    return out, stash
 
 
 class PagedKVCache:
@@ -535,6 +621,9 @@ class Request:
     # request decodes under (0 = the null/base adapter — the plain
     # base model, bit-identical to a no-adapter engine)
     adapter_id: int = 0
+    # probabilistic serving: the request's SamplingParams (seed already
+    # resolved at intake), or None for the greedy/argmax contract
+    sampling: object = None
 
 
 @dataclass(eq=False)
@@ -551,6 +640,12 @@ class _Slot:
     hit_tokens: int = 0                # prefix-cache tokens never computed
     admit_seq: int = 0                 # admission order tiebreak
     adapter_page: int = 0              # adapter-pool page (0 = null)
+    # per-slot sampling state threaded into the compiled steps as
+    # traced per-row data (greedy lanes: 0 / 0 / 1.0 / zero key row)
+    temp: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    key_row: object = None             # [2] uint32 base PRNG key
 
     @property
     def prefilling(self):
@@ -597,7 +692,7 @@ class GenerationEngine:
                  max_queue=None, spec_decode_k=0, drafter=None,
                  mesh=None, mp_degree=None, kv_dtype=None,
                  weight_dtype=None, adapters=None,
-                 adapter_pool_pages=None):
+                 adapter_pool_pages=None, sampling=None):
         from paddle_tpu.ops.paged_attention import (copy_pool_block,
                                                     resolve_backend)
 
@@ -658,6 +753,15 @@ class GenerationEngine:
             "PADDLE_SERVE_KV_DTYPE", kv_dtype)
         self.weight_dtype = self._resolve_dtype_knob(
             "PADDLE_SERVE_WEIGHT_DTYPE", weight_dtype)
+        # probabilistic serving (PR 15): sampling=True threads per-slot
+        # SamplingParams (temperature/top-k/top-p + a [slots, 2] uint32
+        # key row) through every compiled step as traced DATA. Off (the
+        # default) threads nothing — the engine's programs stay
+        # byte-identical to the pre-sampling ones. Env override wins
+        # (deploy-time knob, like the backend).
+        self.sampling = self._resolve_bool_knob(
+            "PADDLE_SERVE_SAMPLING", sampling)
+        self._seed_counter = 0
         # default pool covers every slot at full context (+ null block):
         # correctness-first; serving deployments size it to live-context
         # expectations and lean on the stall/retry path under pressure
@@ -748,19 +852,20 @@ class GenerationEngine:
             if donate else ()
         # with speculation on, the verify step IS the engine's decode
         # step: same probe, same donation, same traces==1 contract —
-        # one program per (backend, K)
-        step_out = self._step_out_shardings(1)
+        # one program per (backend, K). Under sampling the verify step
+        # leads with TWO replicated outputs (choices, accepts).
         self._decode_pure = count_traces(
             self._build_verify() if k > 0 else self._build_decode())
-        self._decode = jax.jit(self._decode_pure,
-                               donate_argnums=self._donate_argnums,
-                               out_shardings=step_out)
+        self._decode_n_out = 2 if (k > 0 and self.sampling) else 1
+        self._decode = jax.jit(
+            self._decode_pure, donate_argnums=self._donate_argnums,
+            out_shardings=self._step_out_shardings(self._decode_n_out))
         self._prefill_pure = count_traces(
             self._build_prefill_chunk() if self.chunked_prefill
             else self._build_prefill())
         self._prefill = jax.jit(self._prefill_pure,
                                 donate_argnums=self._donate_argnums,
-                                out_shardings=step_out)
+                                out_shardings=self._step_out_shardings(1))
         # copy-on-write promotion: one tiny compiled gather/scatter,
         # traced src/dst so every COW reuses the same program
         cow = count_traces(copy_pool_block)
@@ -856,6 +961,95 @@ class GenerationEngine:
                 f"{env_name}/ctor value must be unset or 'int8', got "
                 f"{requested!r}")
         return "int8"
+
+    @staticmethod
+    def _resolve_bool_knob(env_name, requested):
+        """Resolve a boolean serving knob: env override wins, ''
+        means unset, None defaults to off."""
+        env = os.environ.get(env_name)
+        if env not in (None, ""):
+            low = env.lower()
+            if low in ("1", "true", "on", "yes"):
+                return True
+            if low in ("0", "false", "off", "no"):
+                return False
+            raise ValueError(
+                f"{env_name}={env!r} is not a boolean (use 0/1)")
+        return bool(requested) if requested is not None else False
+
+    # -- probabilistic serving (per-slot sampling) -------------------------
+    def _check_sampling(self, params):
+        """Validate intake sampling params: None always passes (the
+        greedy contract); anything else needs the sampling subsystem
+        on. Returns the params unchanged (seed may still be None —
+        `_resolve_seed` assigns one)."""
+        if params is None:
+            return None
+        if not isinstance(params, SamplingParams):
+            raise TypeError(
+                "sampling_params takes a SamplingParams, got "
+                f"{type(params).__name__}")
+        if not self.sampling:
+            raise ValueError(
+                "sampling_params needs GenerationEngine(sampling=True) "
+                "— this engine decodes greedily")
+        return params
+
+    def _resolve_seed(self, params):
+        """Pin a request's seed: explicit seeds pass through, None
+        draws from the engine's deterministic counter — same admission
+        order, same seeds, same tokens."""
+        if params is None or params.seed is not None:
+            return params
+        seed = self._seed_counter
+        self._seed_counter += 1
+        return params.with_seed(seed)
+
+    @staticmethod
+    def _slot_sampling_fields(req):
+        """The per-slot sampling state a request seats with: greedy
+        (or param-less) lanes ride the inert defaults (temp 0, zero
+        key row)."""
+        p = req.sampling
+        if p is None or p.greedy:
+            return {}
+        return dict(temp=float(p.temperature), top_k=int(p.top_k),
+                    top_p=float(p.top_p),
+                    key_row=_sampling_key_row(p.seed))
+
+    def _sampling_host_args(self):
+        """The four traced per-row sampling arrays of one decode/verify
+        dispatch: [slots] temperature/top-k/top-p plus the [slots, 2]
+        uint32 key rows. Idle and greedy lanes ride temp 0 / zero keys
+        — their sampled columns are garbage the argmax select (device)
+        and the host both ignore."""
+        temps = np.zeros(self.num_slots, np.float32)
+        tks = np.zeros(self.num_slots, np.int32)
+        tps = np.ones(self.num_slots, np.float32)
+        keys = np.zeros((self.num_slots, 2), np.uint32)
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.key_row is None:
+                continue
+            temps[i] = slot.temp
+            tks[i] = slot.top_k
+            tps[i] = slot.top_p
+            keys[i] = slot.key_row
+        return [jnp.asarray(temps), jnp.asarray(tks),
+                jnp.asarray(tps), jnp.asarray(keys)]
+
+    @staticmethod
+    def _sampling_host_args_one(slot):
+        """[1]-row edition of `_sampling_host_args` for the prefill
+        steps (one slot per dispatch, like the adapter page row)."""
+        greedy = slot.key_row is None
+        return [jnp.asarray(np.asarray(
+                    [0.0 if greedy else slot.temp], np.float32)),
+                jnp.asarray(np.asarray(
+                    [0 if greedy else slot.top_k], np.int32)),
+                jnp.asarray(np.asarray(
+                    [1.0 if greedy else slot.top_p], np.float32)),
+                jnp.asarray(np.zeros((1, 2), np.uint32) if greedy
+                            else slot.key_row[None])]
 
     # -- multi-tenant adapter serving (paged batched-LoRA) -----------------
     def _resolve_adapters(self, adapters, pages, cfg, model, donate):
@@ -1108,12 +1302,14 @@ class GenerationEngine:
         tail = (repl,) if self.kv_dtype == "int8" else ()
         return (repl,) * n_repl + (pool, pool) + tail
 
-    def _shard_steps(self, fn, n_repl):
+    def _shard_steps(self, fn, n_repl, n_out=1):
         """Wrap a compiled-step body in shard_map over the serving
         mesh: state per `_tp_specs`, pools head-sharded, the `n_repl`
-        trailing host args (tokens/positions/tables/...) replicated;
-        outputs (replicated next-token ids, sharded pools). Identity
-        at mp=1."""
+        trailing host args (tokens/positions/tables/sampling rows/...)
+        replicated; outputs (`n_out` replicated leading outputs —
+        token ids, and under sampling the verify step's
+        choices/accepts pair — then sharded pools). Identity at
+        mp=1."""
         if self._mp_axis is None:
             return fn
         from jax.experimental.shard_map import shard_map
@@ -1134,7 +1330,7 @@ class GenerationEngine:
             fn, mesh=self.mesh,
             in_specs=(list(self._tp_specs), pool, pool) + scales
             + lora + (P(),) * n_repl,
-            out_specs=(P(), pool, pool) + scales,
+            out_specs=(P(),) * n_out + (pool, pool) + scales,
             # all-gathered logits/argmax are replicated by
             # construction; the static rep-checker can't prove it
             check_rep=False)
@@ -1271,6 +1467,23 @@ class GenerationEngine:
             "engine_decode_recompiles_total",
             "Decode retraces past the first compile — nonzero means a "
             "shape-stability bug.")
+        self._m_sampling = m.gauge(
+            "engine_sampling_info",
+            "Probabilistic serving state (1 = this engine threads "
+            "per-slot sampling params through its compiled steps; "
+            "greedy-only engines run the pre-sampling programs "
+            "byte-identically).", labelnames=("enabled",))
+        self._m_sampling.labels(
+            enabled="1" if self.sampling else "0").set(1)
+        # registered only when the subsystem is on, so a plain
+        # engine's exposition is unchanged (the adapter precedent)
+        self._m_sampled_tokens = None
+        if self.sampling:
+            self._m_sampled_tokens = m.counter(
+                "engine_sampled_tokens_total",
+                "Tokens emitted by sampled (temperature > 0) lanes — "
+                "greedy lanes count only in "
+                "engine_tokens_generated_total.")
         self._m_backend = m.gauge(
             "engine_attention_backend_info",
             "Paged-attention kernel backend the compiled decode step "
@@ -1416,11 +1629,16 @@ class GenerationEngine:
         backend = self.attention_backend
         mp_axis = self._mp_axis
         use_q = self.kv_dtype == "int8"
+        use_s = self.sampling
 
         def decode_fn(state_arrays, kpool, vpool, *rest):
             scales = rest[0] if use_q else None
-            lora, (tokens, positions, tables) = \
-                self._lora_args(rest[1:] if use_q else rest)
+            lora, rest = self._lora_args(rest[1:] if use_q else rest)
+            if use_s:
+                (tokens, positions, tables,
+                 temps, tks, tps, krows) = rest
+            else:
+                tokens, positions, tables = rest
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
                 r = model.gpt.forward_decode_paged(
@@ -1431,27 +1649,47 @@ class GenerationEngine:
                     kv_scales=None if scales is None
                     else Tensor._wrap(scales), lora=lora)
                 logits = model._logits_of(r[0], mp_axis=mp_axis)
-                nxt = jnp.argmax(logits._array[:, 0], axis=-1) \
-                    .astype(jnp.int32)                # logits [slots,1,V]
+                if use_s:
+                    # per-slot categorical draws on device; greedy
+                    # rows take the literal argmax (bit-identical to
+                    # the branch below). Draws fold (key row, this
+                    # row's absolute position) — replicated at mp>1:
+                    # same keys, same all-gathered logits, no
+                    # collective.
+                    from paddle_tpu.ops.sampling import sample_token
+
+                    nxt = sample_token(logits._array[:, 0], temps,
+                                       tks, tps, krows, positions)
+                else:
+                    nxt = jnp.argmax(logits._array[:, 0], axis=-1) \
+                        .astype(jnp.int32)            # logits [slots,1,V]
                 return (nxt,) + tuple(t._array for t in r[1:])
 
         decode_fn.__name__ = "engine_decode_step"
-        return self._shard_steps(decode_fn, n_repl=3)
+        return self._shard_steps(decode_fn, n_repl=7 if use_s else 3)
 
     def _build_verify(self):
         """The speculative decode step: one fixed `[slots, K+1]` window
         scores the feed token plus up to K drafts per lane in a single
         target-model pass. Per-row positions and draft lengths are
-        traced, so every acceptance outcome reuses ONE program."""
+        traced, so every acceptance outcome reuses ONE program. Under
+        sampling the step ALSO runs the rejection-sampling acceptance
+        on device (all K+1 logit positions are in hand) and leads with
+        the (choices, accepts) pair instead of the argmax row."""
         model, state = self.model, self._state
         backend = self.attention_backend
         mp_axis = self._mp_axis
         use_q = self.kv_dtype == "int8"
+        use_s = self.sampling
 
         def verify_fn(state_arrays, kpool, vpool, *rest):
             scales = rest[0] if use_q else None
-            lora, (tokens, positions, dlens, tables) = \
-                self._lora_args(rest[1:] if use_q else rest)
+            lora, rest = self._lora_args(rest[1:] if use_q else rest)
+            if use_s:
+                (tokens, positions, dlens, tables,
+                 temps, tks, tps, krows) = rest
+            else:
+                tokens, positions, dlens, tables = rest
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
                 r = model.gpt.forward_verify_paged(
@@ -1462,12 +1700,26 @@ class GenerationEngine:
                     kv_scales=None if scales is None
                     else Tensor._wrap(scales), lora=lora)
                 logits = model._logits_of(r[0], mp_axis=mp_axis)
+                if use_s:
+                    # rejection-sampling acceptance in the same
+                    # compiled program: per-row accept coins + the
+                    # residual/bonus resamples (greedy rows pin the
+                    # argmax / equality contract) — replicated at
+                    # mp>1, no collective
+                    from paddle_tpu.ops.sampling import verify_window
+
+                    choices, accepts = verify_window(
+                        logits._array, tokens, dlens, temps, tks,
+                        tps, krows, positions)
+                    return (choices, accepts) \
+                        + tuple(t._array for t in r[1:])
                 nxt = jnp.argmax(logits._array, axis=-1) \
                     .astype(jnp.int32)           # logits [slots,K+1,V]
                 return (nxt,) + tuple(t._array for t in r[1:])
 
         verify_fn.__name__ = "engine_verify_step"
-        return self._shard_steps(verify_fn, n_repl=4)
+        return self._shard_steps(verify_fn, n_repl=8 if use_s else 4,
+                                 n_out=2 if use_s else 1)
 
     def _build_prefill(self):
         from paddle_tpu.ops.paged_attention import paged_prefill_write
@@ -1475,12 +1727,16 @@ class GenerationEngine:
         model, state = self.model, self._state
         mp_axis = self._mp_axis
         use_q = self.kv_dtype == "int8"
+        use_s = self.sampling
 
         def prefill_fn(state_arrays, kpool, vpool, *rest):
             # tokens [1, bucket]; plen traced -> one program per bucket
             scales = rest[0] if use_q else None
-            lora, (tokens, plen, table_row) = \
-                self._lora_args(rest[1:] if use_q else rest)
+            lora, rest = self._lora_args(rest[1:] if use_q else rest)
+            if use_s:
+                tokens, plen, table_row, temps, tks, tps, krows = rest
+            else:
+                tokens, plen, table_row = rest
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
                 hidden, ks, vs = model.gpt.forward_prefill(
@@ -1498,24 +1754,41 @@ class GenerationEngine:
                     .sum(axis=1, keepdims=True)
                 logits = model._logits_of(Tensor._wrap(h_last),
                                           mp_axis=mp_axis)
-                nxt = jnp.argmax(logits._array[0, 0]).astype(jnp.int32)
+                if use_s:
+                    # the FIRST generated token samples too: it lands
+                    # at position plen, so its draw folds plen-1 —
+                    # exactly the key a full-prefix-hit decode (or the
+                    # final prefill chunk) would fold for it
+                    from paddle_tpu.ops.sampling import sample_token
+
+                    nxt = sample_token(
+                        logits._array[:, 0], temps, tks, tps, krows,
+                        jnp.maximum(plen - 1, 0).reshape(1))[0]
+                else:
+                    nxt = jnp.argmax(logits._array[0, 0]) \
+                        .astype(jnp.int32)
                 return (nxt,) + tuple(t._array for t in w)
 
         prefill_fn.__name__ = "engine_prefill"
-        return self._shard_steps(prefill_fn, n_repl=3)
+        return self._shard_steps(prefill_fn, n_repl=7 if use_s else 3)
 
     def _build_prefill_chunk(self):
         model, state = self.model, self._state
         C = self.prefill_chunk
         mp_axis = self._mp_axis
         use_q = self.kv_dtype == "int8"
+        use_s = self.sampling
 
         def prefill_chunk_fn(state_arrays, kpool, vpool, *rest):
             # tokens [1, C] FIXED; start/plen traced -> ONE program
             # serves every chunk of every prompt length
             scales = rest[0] if use_q else None
-            lora, (tokens, start, plen, table_row) = \
-                self._lora_args(rest[1:] if use_q else rest)
+            lora, rest = self._lora_args(rest[1:] if use_q else rest)
+            if use_s:
+                (tokens, start, plen, table_row,
+                 temps, tks, tps, krows) = rest
+            else:
+                tokens, start, plen, table_row = rest
             arrays = self._materialize_state(state_arrays)
             with bound_state(zip(state, arrays), state):
                 r = model.gpt.forward_prefill_chunk(
@@ -1535,11 +1808,24 @@ class GenerationEngine:
                     .sum(axis=1, keepdims=True)
                 logits = model._logits_of(Tensor._wrap(h_last),
                                           mp_axis=mp_axis)
-                nxt = jnp.argmax(logits._array[0, 0]).astype(jnp.int32)
+                if use_s:
+                    # the first generated token's draw folds plen-1
+                    # (it lands at position plen) — identical to the
+                    # bucketed prefill's and the full-prefix-hit
+                    # decode's key for that token
+                    from paddle_tpu.ops.sampling import sample_token
+
+                    nxt = sample_token(
+                        logits._array[:, 0], temps, tks, tps, krows,
+                        jnp.maximum(plen - 1, 0).reshape(1))[0]
+                else:
+                    nxt = jnp.argmax(logits._array[0, 0]) \
+                        .astype(jnp.int32)
                 return (nxt,) + tuple(t._array for t in r[1:])
 
         prefill_chunk_fn.__name__ = "engine_prefill_chunk"
-        return self._shard_steps(prefill_chunk_fn, n_repl=4)
+        return self._shard_steps(prefill_chunk_fn,
+                                 n_repl=8 if use_s else 4)
 
     # -- recompile probes (CI contract) ------------------------------------
     @property
@@ -1590,7 +1876,8 @@ class GenerationEngine:
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
                     req_id=None, priority="standard",
-                    prefill_only=False, adapter_id=0):
+                    prefill_only=False, adapter_id=0,
+                    sampling_params=None):
         """Queue a request; admitted into a free slot between decode
         iterations (may be called while `run`/`step` is mid-stream).
         `priority` is one of PRIORITY_CLASSES — higher classes admit
@@ -1608,20 +1895,30 @@ class GenerationEngine:
 
         `adapter_id` selects the tenant LoRA adapter the request
         decodes under (needs `GenerationEngine(adapters=...)`; 0 — the
-        default — is the null/base adapter and always valid)."""
+        default — is the null/base adapter and always valid).
+
+        `sampling_params` (a `SamplingParams`; needs
+        `GenerationEngine(sampling=True)`) selects per-request
+        temperature/top-k/top-p sampling — None (the default) and
+        temperature=0 are the greedy contract, bit-identical to a
+        no-sampling engine. A None seed is resolved here from the
+        engine's deterministic counter, so a fixed trace replays
+        token-for-token."""
         if prefill_only and max_new_tokens != 1:
             raise ValueError(
                 "prefill_only requests carry max_new_tokens=1 (the "
                 "single token the final prefill chunk yields); the "
                 "decode replica owns the remaining budget")
         adapter_id = self._check_adapter(adapter_id)
+        sampling_params = self._resolve_seed(
+            self._check_sampling(sampling_params))
         prompt, req_id = self._intake_guard(prompt, max_new_tokens,
                                             priority, req_id)
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
         req = Request(req_id, prompt, int(max_new_tokens), eos,
                       arrived_at=time.perf_counter(), priority=priority,
                       prefill_only=bool(prefill_only),
-                      adapter_id=adapter_id)
+                      adapter_id=adapter_id, sampling=sampling_params)
         if self.max_queue is not None \
                 and self.num_pending >= self.max_queue:
             victim = self._shed_victim(priority)
@@ -1667,12 +1964,13 @@ class GenerationEngine:
             return list(self._q_arrays)
         return [t._array for t in self._state]
 
-    def _dispatch_step(self, jitted, *host_args):
+    def _dispatch_step(self, jitted, *host_args, n_out=1):
         """Invoke a compiled step: state + pools (+ the int8 scale
         array) (+ the adapter-pool arrays) threaded in, updated pools
-        (+ scales) re-seated on the cache, the leading token output
-        returned. With adapters on, the caller appends the per-slot
-        adapter page row as the LAST host arg."""
+        (+ scales) re-seated on the cache, the `n_out` leading outputs
+        returned (token ids; the sampling verify step leads with its
+        (choices, accepts) pair). With adapters on, the caller appends
+        the per-slot adapter page row as the LAST host arg."""
         c = self.cache
         args = [self._state_arrays(), c.kpool, c.vpool]
         if c.scales is not None:
@@ -1681,10 +1979,10 @@ class GenerationEngine:
             args.append(self.adapter_pool.arrays())
         out = jitted(*args, *host_args)
         if c.scales is not None:
-            nxt, c.kpool, c.vpool, c.scales = out
+            c.kpool, c.vpool, c.scales = out[n_out:]
         else:
-            nxt, c.kpool, c.vpool = out
-        return nxt
+            c.kpool, c.vpool = out[n_out:]
+        return out[0] if n_out == 1 else out[:n_out]
 
     def _in_flight(self):
         """Ids that would collide with a new request: queued, seated in
@@ -1718,6 +2016,16 @@ class GenerationEngine:
             self.adapter_pool.release(slot.req.adapter_id)
             self._update_adapter_gauges()
 
+    def _note_tokens(self, req, n=1):
+        """Account `n` freshly emitted tokens: the engine counter, the
+        tokens-total series, and (probabilistic serving) the
+        sampled-token series for temperature>0 lanes."""
+        self.tokens_generated += n
+        self._m_tokens.inc(n)
+        if self._m_sampled_tokens is not None \
+                and req.sampling is not None and not req.sampling.greedy:
+            self._m_sampled_tokens.inc(n)
+
     def _finish(self, slot, reason):
         req = slot.req
         self._results[req.req_id] = \
@@ -1736,8 +2044,7 @@ class GenerationEngine:
         now = time.perf_counter()
         slot.generated.append(first)
         slot.last_token_at = now
-        self.tokens_generated += 1
-        self._m_tokens.inc()
+        self._note_tokens(req)
         if req.arrived_at is not None:
             self._obs_ttft(req, now - req.arrived_at)
         if self.enable_prefix_cache:
@@ -1812,7 +2119,8 @@ class GenerationEngine:
                     self._m_hit_tokens.inc(hit)
             slot = _Slot(req=req, blocks=list(blocks), prefill_pos=hit,
                          hit_tokens=hit, admit_seq=self._admit_counter,
-                         adapter_page=page)
+                         adapter_page=page,
+                         **self._slot_sampling_fields(req))
             self._admit_counter += 1
             self._slots[self._slots.index(None)] = slot
             self._m_admissions.inc()
@@ -1869,6 +2177,9 @@ class GenerationEngine:
             row[:len(slot.blocks)] = slot.blocks
             args = [jnp.asarray(tokens), jnp.int32(start),
                     jnp.int32(plen), jnp.asarray(row)]
+            if self.sampling:
+                # the chunk serves ONE slot: its sampling rows, [1]
+                args.extend(self._sampling_host_args_one(slot))
             if self.adapter_pool is not None:
                 # the chunk serves ONE slot: its adapter page, [1]-row
                 args.append(jnp.asarray(
@@ -1918,13 +2229,16 @@ class GenerationEngine:
             row[:need] = blocks
             slot = _Slot(req=req, blocks=blocks, prefill_pos=plen,
                          admit_seq=self._admit_counter,
-                         adapter_page=page)
+                         adapter_page=page,
+                         **self._slot_sampling_fields(req))
             self._admit_counter += 1
             self._slots[self._slots.index(None)] = slot
             self._m_admissions.inc()
             admitted += 1
             args = [jnp.asarray(tokens), jnp.int32(plen),
                     jnp.asarray(row)]
+            if self.sampling:
+                args.extend(self._sampling_host_args_one(slot))
             if self.adapter_pool is not None:
                 args.append(jnp.asarray(
                     np.asarray([slot.adapter_page], np.int32)))
@@ -2014,6 +2328,10 @@ class GenerationEngine:
             arows[i] = slot.adapter_page
         args = [jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(tables)]
+        if self.sampling:
+            # per-slot sampling rows (idle/greedy lanes ride temp 0 —
+            # the argmax select, like the null block)
+            args.extend(self._sampling_host_args())
         if self.adapter_pool is not None:
             # per-slot adapter page row (idle/stalled lanes ride the
             # null page 0 — exact-zero delta, like the null block)
@@ -2030,9 +2348,8 @@ class GenerationEngine:
             tok = int(nxt[i])
             is_first = not slot.generated    # full-prefix-hit lane
             slot.generated.append(tok)
-            self.tokens_generated += 1
-            self._m_tokens.inc()
             req = slot.req
+            self._note_tokens(req)
             if is_first:
                 # this decode produced the request's FIRST token (its
                 # whole prompt came from the prefix cache)
@@ -2175,27 +2492,51 @@ class GenerationEngine:
             arows[i] = slot.adapter_page
         args = [jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(dlens), jnp.asarray(tables)]
+        if self.sampling:
+            args.extend(self._sampling_host_args())
         if self.adapter_pool is not None:
             args.append(jnp.asarray(arows))
         with RecordEvent("engine.decode"):
             t_dec = time.perf_counter()
-            nxt = self._dispatch_step(self._decode, *args)
-            nxt = np.asarray(nxt)      # sync: [slots, K+1] argmaxes
+            out_dev = self._dispatch_step(self._decode, *args,
+                                          n_out=self._decode_n_out)
+            if self.sampling:
+                # sync: per-row stop-choices + accept flags
+                choices = np.asarray(out_dev[0])
+                accepts = np.asarray(out_dev[1])
+                nxt = None
+            else:
+                nxt = np.asarray(out_dev)  # sync: [slots, K+1] argmaxes
             self._m_decode_seconds.observe(
                 time.perf_counter() - t_dec)
         now = time.perf_counter()
         for i in runnable:
             slot = self._slots[i]
             req = slot.req
-            out, d = nxt[i], drafts[i]
-            # exact greedy acceptance: the target's own next token,
-            # then every draft token that EQUALS the target's argmax
-            # at its position (each match validates the next column)
-            acc = [int(out[0])]
-            for j, dj in enumerate(d):
-                if dj != int(out[j]):
-                    break
-                acc.append(int(out[j + 1]))
+            d = drafts[i]
+            if self.sampling:
+                # rejection-sampling acceptance (computed on device):
+                # accept the longest draft prefix whose coins passed,
+                # then the stop row's choice — the residual resample
+                # on a rejection, the bonus draw on a full accept.
+                # Greedy lanes' flags are exact argmax equality and
+                # their choices the argmax, so this walk reproduces
+                # the exact-acceptance stream bit-for-bit.
+                n = 0
+                while n < len(d) and accepts[i, n]:
+                    n += 1
+                acc = [int(t) for t in d[:n]] + [int(choices[i, n])]
+            else:
+                out = nxt[i]
+                # exact greedy acceptance: the target's own next
+                # token, then every draft token that EQUALS the
+                # target's argmax at its position (each match
+                # validates the next column)
+                acc = [int(out[0])]
+                for j, dj in enumerate(d):
+                    if dj != int(out[j]):
+                        break
+                    acc.append(int(out[j + 1]))
             self._m_spec_ok.inc(len(acc) - 1)
             self._m_spec_rej.inc(len(d) - (len(acc) - 1))
             # EOS / length truncation: emit stops AT the first stop
@@ -2211,8 +2552,7 @@ class GenerationEngine:
             m_tok = len(emit)
             is_first = not slot.generated      # full-prefix-hit lane
             slot.generated.extend(emit)
-            self.tokens_generated += m_tok
-            self._m_tokens.inc(m_tok)
+            self._note_tokens(req, m_tok)
             self._m_spec_accepted.observe(m_tok)
             proposed = self._m_spec_ok.value + self._m_spec_rej.value
             if proposed:
@@ -2290,6 +2630,37 @@ class GenerationEngine:
         out, self._results = self._results, {}
         return out
 
+    def best_of_n(self, prompt, n, max_new_tokens,
+                  sampling_params=None, eos_token_id=None,
+                  priority="standard", adapter_id=0):
+        """Fan ONE prompt into `n` sampled candidates sharing its
+        prefix-cache blocks: candidate 0 is served first (its prefill
+        writes and registers the prompt's full blocks ONCE), then
+        candidates 1..n-1 admit with a full-prefix hit — the shared
+        prompt blocks are seated read-only in each lane's table, never
+        re-prefilled and never duplicated (copy-on-write keeps decode
+        writes private, the PR 6 contract). Candidate i samples under
+        seed `base + i` (base from `sampling_params.seed`, or the
+        engine counter when None), so a fixed base replays all n
+        candidates token-for-token.
+
+        Drives `run()`; other queued work is served along the way and
+        its finishes stay collectable via `pop_results`/`run`. Returns
+        the n candidate token lists (prompt + generated), seed
+        order."""
+        params, base, self._seed_counter = _best_of_n_intake(
+            self, sampling_params, n, self._seed_counter)
+        out, stash = _best_of_n_fanout(
+            lambda p: self.add_request(
+                prompt, max_new_tokens, eos_token_id=eos_token_id,
+                priority=priority, adapter_id=adapter_id,
+                sampling_params=p),
+            self.run, params, n, base)
+        # bystander finishes collected by the two run()s stay
+        # deliverable through the normal channels
+        self._results.update(stash)
+        return out
+
     # -- disaggregated prefill/decode (fleet handoff) ----------------------
     def take_handoff(self, req_id):
         """Claim a finished prefill-only request's parked KV footprint:
@@ -2312,7 +2683,7 @@ class GenerationEngine:
     def adopt_request(self, prompt, first_token, blocks,
                       max_new_tokens, eos_token_id=None, req_id=None,
                       priority="standard", arrived_at=None,
-                      adapter_id=0):
+                      adapter_id=0, sampling_params=None):
         """Seat a request whose prompt KV is ALREADY in this engine's
         pool — the decode-side intake of disaggregated serving. The
         fleet allocates `blocks` from this engine's cache, ingests the
@@ -2329,8 +2700,20 @@ class GenerationEngine:
         request decodes under — the page comes from THIS engine's
         adapter pool (the prefill replica's page never travels); the
         fleet probes `adapter_page_available` before placing, so an
-        unavailable page here is a caller bug and raises."""
+        unavailable page here is a caller bug and raises.
+        `sampling_params` must arrive with its seed RESOLVED (the
+        prefill replica's seed travels with the handoff): the adopted
+        lane re-derives the exact per-slot key row the colocated lane
+        would carry, so sampled disaggregated output stays
+        token-identical to colocated."""
         adapter_id = self._check_adapter(adapter_id)
+        sampling_params = self._check_sampling(sampling_params)
+        if sampling_params is not None and not sampling_params.greedy \
+                and sampling_params.seed is None:
+            raise ValueError(
+                "adopted sampled requests need an explicit seed — "
+                "resolve it at fleet intake so the prefill replica's "
+                "key state travels with the handoff")
         prompt, req_id = self._intake_guard(prompt, max_new_tokens,
                                             priority, req_id)
         need = math.ceil(prompt.size / self.block_size)
@@ -2346,7 +2729,7 @@ class GenerationEngine:
             else eos_token_id
         req = Request(req_id, prompt, int(max_new_tokens), eos,
                       arrived_at=arrived_at, priority=priority,
-                      adapter_id=adapter_id)
+                      adapter_id=adapter_id, sampling=sampling_params)
         page = self._acquire_adapter(req)
         if page is None:
             raise RuntimeError(
@@ -2357,7 +2740,8 @@ class GenerationEngine:
                      generated=[int(first_token)],
                      last_token_at=now, prefill_pos=int(prompt.size),
                      admit_seq=self._admit_counter,
-                     adapter_page=page)
+                     adapter_page=page,
+                     **self._slot_sampling_fields(req))
         self._admit_counter += 1
         self._slots[self._slots.index(None)] = slot
         self._m_admissions.inc()

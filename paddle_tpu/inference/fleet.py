@@ -70,6 +70,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.inference.engine import (PRIORITY_CLASSES,
                                          GenerationEngine, prefix_key)
+from paddle_tpu.inference.sampling import SamplingParams
 from paddle_tpu.observability.metrics import (LATENCY_BUCKETS,
                                               MetricsRegistry,
                                               label_snapshot,
@@ -190,6 +191,11 @@ class ServingFleet:
         self._handoff_seq = 0
         self._done = {}
         self._auto_id = 0
+        # probabilistic serving: None seeds resolve HERE, before a
+        # disaggregated handoff splits the request across replicas —
+        # the prefill replica's first-token draw and the decode
+        # replica's adopted key state must come from the SAME seed
+        self._seed_counter = 0
         self._draining = False
         self.metrics = registry if registry is not None \
             else MetricsRegistry()
@@ -399,7 +405,8 @@ class ServingFleet:
         return cold, "least_loaded", 0
 
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
-                    req_id=None, priority="standard", adapter_id=0):
+                    req_id=None, priority="standard", adapter_id=0,
+                    sampling_params=None):
         """Admit one request into the fleet. Same contract as
         `GenerationEngine.add_request` (priority QoS, auto ids,
         validation, per-tenant `adapter_id` when the replicas carry an
@@ -412,7 +419,15 @@ class ServingFleet:
         under two tenants warms two independent chains), least-loaded
         otherwise; in a disaggregated fleet the request lands on a
         prefill replica as `prefill_only` and the decode budget rides
-        the handoff."""
+        the handoff.
+
+        `sampling_params` (needs replicas built with `sampling=True`)
+        rides to the serving replica AND through the disaggregated
+        handoff: a None seed is resolved by the FLEET's deterministic
+        counter before routing, so the prefill replica's first-token
+        draw and the decode replica's adopted key state share one
+        seed — disaggregated sampled output is token-identical to
+        colocated."""
         if self._draining:
             raise RuntimeError(
                 "fleet is draining — admissions are closed")
@@ -429,6 +444,14 @@ class ServingFleet:
         # all): an unknown id must reject cleanly, not leave a phantom
         # in-flight request that deadlocks every later run()
         adapter_id = self._any_engine()._check_adapter(adapter_id)
+        # same pre-mutation discipline for sampling: validate against
+        # any (homogeneous) replica, then pin a None seed fleet-side
+        sampling_params = self._any_engine()._check_sampling(
+            sampling_params)
+        if sampling_params is not None and sampling_params.seed is None:
+            sampling_params = sampling_params.with_seed(
+                self._seed_counter)
+            self._seed_counter += 1
         total = prompt.size + int(max_new_tokens)
         limit = self._any_engine().max_model_len
         if total > limit:
@@ -465,6 +488,7 @@ class ServingFleet:
                 "eos": eos_token_id, "priority": priority,
                 "arrived": time.perf_counter(), "replica": rep.rid,
                 "adapter_id": int(adapter_id),
+                "sampling": sampling_params,
                 "phase": "prefill" if self.disaggregated else "serve"}
         self._requests[req_id] = info
         if self.disaggregated:
@@ -472,13 +496,41 @@ class ServingFleet:
                                    eos_token_id=eos_token_id,
                                    req_id=req_id, priority=priority,
                                    prefill_only=True,
-                                   adapter_id=adapter_id)
+                                   adapter_id=adapter_id,
+                                   sampling_params=sampling_params)
         else:
             rep.engine.add_request(prompt, max_new_tokens,
                                    eos_token_id=eos_token_id,
                                    req_id=req_id, priority=priority,
-                                   adapter_id=adapter_id)
+                                   adapter_id=adapter_id,
+                                   sampling_params=sampling_params)
         return req_id
+
+    def best_of_n(self, prompt, n, max_new_tokens,
+                  sampling_params=None, eos_token_id=None,
+                  priority="standard", adapter_id=0):
+        """Fleet edition of `GenerationEngine.best_of_n`: candidate 0
+        is served to completion first (its prefill warms ONE replica's
+        prefix chain), then candidates 1..n-1 — same prompt, seeds
+        `base+1..base+n-1` — route by prefix affinity to that warm
+        replica and seat the prompt's blocks read-only (seated once
+        fleet-wide, not n times). Drives `run()`; other in-flight work
+        is served along the way and stays collectable. Returns the n
+        candidate token lists in seed order."""
+        from paddle_tpu.inference.engine import (_best_of_n_fanout,
+                                                 _best_of_n_intake)
+
+        params, base, self._seed_counter = _best_of_n_intake(
+            self._any_engine(), sampling_params, n,
+            self._seed_counter)
+        out, stash = _best_of_n_fanout(
+            lambda p: self.add_request(
+                prompt, max_new_tokens, eos_token_id=eos_token_id,
+                priority=priority, adapter_id=adapter_id,
+                sampling_params=p),
+            self.run, params, n, base)
+        self._done.update(stash)       # bystander finishes collectable
+        return out
 
     # -- disaggregated handoff ---------------------------------------------
     def _export_handoff(self, rep, req_id, toks):
@@ -560,7 +612,8 @@ class ServingFleet:
                           eos_token_id=info["eos"], req_id=req_id,
                           priority=info["priority"],
                           arrived_at=info["arrived"],
-                          adapter_id=info.get("adapter_id", 0))
+                          adapter_id=info.get("adapter_id", 0),
+                          sampling_params=info.get("sampling"))
         info["phase"] = "decode"
         info["replica"] = rep.rid
         self._m_handoffs.inc()

@@ -28,17 +28,23 @@ al. (2023) model-free discussion): it matches the lane's most recent
 n-gram against its own earlier context (prompt + generated tokens) and
 proposes the continuation that followed the latest previous
 occurrence. Summarization/code/chat workloads repeat long spans of
-their prompt, so this hits often at zero draft-model cost. A learned
-drafter (e.g. a tiny GPT sharing the tokenizer) implements the same
-protocol — typically `argmax`-decoding `k` tokens from
-`prompt + generated` — and drops in via `GenerationEngine(...,
-drafter=...)`.
+their prompt, so this hits often at zero draft-model cost.
+
+`GptDrafter` is the learned drafter the protocol was built for (the
+PR 7 follow-up): a SMALL GPT sharing the target's tokenizer,
+greedy-decoded host-side between compiled steps. Drafter quality never
+changes greedy output tokens (the exact-acceptance contract) and never
+changes a sampled request's DISTRIBUTION (the rejection-sampling
+contract) — a better drafter only raises the accepted-tokens-per-step
+rate. Both drafters are deterministic (their draft distribution is a
+point mass), which is exactly the case the engine's on-device
+rejection sampler assumes.
 """
 from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["NgramDrafter"]
+__all__ = ["NgramDrafter", "GptDrafter"]
 
 
 class NgramDrafter:
@@ -80,3 +86,59 @@ class NgramDrafter:
                 s0 = int(starts[-1])           # most recent occurrence
                 return [int(t) for t in ctx[s0 + n:s0 + n + k]]
         return []
+
+
+class GptDrafter:
+    """Learned tiny-GPT drafter: greedy host-side decode of a small
+    draft model through the `propose(prompt, generated, k)` protocol.
+
+        draft = GPTForCausalLM(GPTConfig.tiny(...)); draft.eval()
+        engine = GenerationEngine(model, spec_decode_k=4,
+                                  drafter=GptDrafter(draft))
+
+    The draft model must share the target's tokenizer (same id space);
+    a context containing ids outside the draft vocab proposes nothing
+    (the engine falls back to a plain one-token step — correctness
+    never depends on the drafter). Proposals are the draft model's
+    argmax continuations of `prompt + generated`, re-fed one token at
+    a time with the context window clipped from the LEFT to the draft
+    model's position table; the forwards run EAGERLY between compiled
+    engine steps (host-side, never traced), so a deep draft model
+    costs host latency, not target-step recompiles."""
+
+    def __init__(self, model, max_context=None):
+        cfg = model.config
+        if model.training and cfg.dropout > 0:
+            raise ValueError(
+                "GptDrafter decodes deterministically (no dropout) — "
+                "call draft_model.eval() first")
+        self.model = model
+        self.max_context = cfg.max_seq_len if max_context is None \
+            else int(max_context)
+        if self.max_context < 1 \
+                or self.max_context > cfg.max_seq_len:
+            raise ValueError(
+                f"max_context={self.max_context} must be in "
+                f"[1, {cfg.max_seq_len}] (the draft position table)")
+
+    def _next_token(self, window):
+        from paddle_tpu.core.tensor import Tensor
+
+        ids = Tensor._wrap(np.asarray(window, np.int32)[None])
+        logits = self.model(ids)               # [1, S, V] eager
+        return int(np.argmax(np.asarray(logits._array)[0, -1]))
+
+    def propose(self, prompt, generated, k):
+        if k <= 0:
+            return []
+        ctx = [int(t) for t in np.asarray(prompt, np.int64).reshape(-1)]
+        ctx += [int(t) for t in generated]
+        vocab = self.model.config.vocab_size
+        if any(t < 0 or t >= vocab for t in ctx):
+            return []                  # disjoint id space: don't guess
+        out = []
+        for _ in range(int(k)):
+            t = self._next_token(ctx[-self.max_context:])
+            out.append(t)
+            ctx.append(t)
+        return out

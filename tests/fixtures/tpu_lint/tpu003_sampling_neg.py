@@ -1,0 +1,19 @@
+import jax
+
+
+def sampling_row_folds_per_draw(key, pos, logits):
+    # the ops/sampling.py pattern: fold the slot's position into the
+    # base key, then a draw-purpose salt per consumer — every derived
+    # key feeds exactly one sampler
+    k = jax.random.fold_in(key, pos)
+    u = jax.random.uniform(jax.random.fold_in(k, 1))
+    r = jax.random.categorical(jax.random.fold_in(k, 0), logits)
+    return u, r
+
+
+def per_slot_fold(keys, positions, logits):
+    def row(row_key, pos, row_lg):
+        k = jax.random.fold_in(row_key, pos)
+        return jax.random.categorical(k, row_lg)
+
+    return jax.vmap(row)(keys, positions, logits)

@@ -1,0 +1,11 @@
+import jax
+
+
+def sampling_row_reuses_folded_key(key, pos, logits):
+    # the per-slot sampling-step anti-pattern: ONE folded key consumed
+    # by both the acceptance uniform and the resample draw — the coin
+    # and the categorical would be correlated
+    k = jax.random.fold_in(key, pos)
+    u = jax.random.uniform(k)
+    r = jax.random.categorical(k, logits)
+    return u, r

@@ -32,6 +32,10 @@ def hits(findings, rule):
     ("TPU001", "tpu001_pos.py", "tpu001_neg.py", [8, 9, 10, 16]),
     ("TPU002", "tpu002_pos.py", "tpu002_neg.py", [6, 16]),
     ("TPU003", "tpu003_pos.py", "tpu003_neg.py", [6, 13]),
+    # the PR-15 sampling-step key-fold pattern: a folded per-slot key
+    # consumed twice fires; fold_in-per-draw (ops/sampling.py) passes
+    ("TPU003", "tpu003_sampling_pos.py", "tpu003_sampling_neg.py",
+     [10]),
     ("TPU004", "tpu004_pos.py", "tpu004_neg.py", [8, 14]),
     ("TPU005", "tpu005_pos.py", "tpu005_neg.py", [10, 11]),
     ("TPU006", "tpu006_pos.py", "tpu006_neg.py", [3, 9]),
@@ -232,12 +236,13 @@ def test_cli_stats_reports_counts_and_unparseable():
     res = _run_lint([str(FIXTURES), "--baseline", "none", "--stats"])
     assert res.returncode == 1
     out = res.stdout
-    assert "files analyzed: 21" in out
+    assert "files analyzed: 23" in out
     assert "UNPARSEABLE files: 1" in out
     assert "unparseable.py" in out
     # per-rule counts visible (no silent skips); the shard_map
     # fixtures add one TPU001 and one TPU007 hit
-    for rule, n in [("TPU001", 5), ("TPU002", 2), ("TPU003", 2),
+    # the PR-15 sampling fixtures add one TPU003 hit
+    for rule, n in [("TPU001", 5), ("TPU002", 2), ("TPU003", 3),
                     ("TPU004", 2), ("TPU005", 4), ("TPU006", 2),
                     ("TPU007", 2), ("TPU008", 1)]:
         assert any(line.startswith(rule) and line.rstrip().endswith(str(n))

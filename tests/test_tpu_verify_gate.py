@@ -71,11 +71,16 @@ def test_matrix_is_contract_clean(matrix_result):
     # per-block-scaled KV pools + int8 weights) — plus the 4 PR-13
     # adapter-threaded programs (LORA_CONFIGS: a plain fp mp=1
     # decode + both prefills, and the composed
-    # pallas/K=4/mp=2/int8 verify step) — plus the 4 PR-14 fused
-    # Pallas conv programs (both kernel families x stride)
-    assert len(res.programs) == 36
-    assert sum(",int8" in p.config for p in res.programs) == 15
+    # pallas/K=4/mp=2/int8 verify step) — plus the 4 PR-15
+    # sampling-threaded programs (SAMPLING_CONFIGS: a plain fp mp=1
+    # sampled decode + both sampled prefills, and the composed
+    # pallas/K=4/mp=2/int8 rejection-sampling verify step) — plus the
+    # 4 PR-14 fused Pallas conv programs (both kernel families x
+    # stride)
+    assert len(res.programs) == 40
+    assert sum(",int8" in p.config for p in res.programs) == 16
     assert sum(",lora" in p.config for p in res.programs) == 4
+    assert sum(",sampling" in p.config for p in res.programs) == 4
     assert sum(p.contract.name.startswith("conv_bn_relu")
                for p in res.programs) == 4
     names = {p.contract.name for p in res.programs}
@@ -224,6 +229,20 @@ def test_sharded_engine_still_token_exact_after_donation_fix():
     assert serve(1) == serve(2)
 
 
+def test_harvest_accepts_legacy_matrix_shapes():
+    """Pre-sampling callers hold 3/4/5-tuple explicit matrix entries:
+    the normalizer must pad the MISSING trailing fields with their
+    defaults (kv=None, lora=False, sampling=False) — positional
+    slicing once handed a 5-tuple samp=None and tripped the
+    PADDLE_SERVE_SAMPLING leak guard on a clean environment."""
+    from paddle_tpu.analysis.trace.harvest import harvest
+
+    programs = harvest(matrix=(("dense", 0, 1, None, False),))
+    # a dense K=0 mp=1 fp config: decode + both prefills + cow
+    assert len(programs) == 4
+    assert all(",sampling" not in p.config for p in programs)
+
+
 def test_cli_acceptance_command_exits_zero():
     """The ISSUE acceptance command, verbatim: the CLI runs the full
     contract matrix self-clean on CPU."""
@@ -233,4 +252,4 @@ def test_cli_acceptance_command_exits_zero():
         [sys.executable, os.path.join(REPO, "tools", "tpu_verify.py")],
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "tpu-verify clean: 36 programs" in res.stdout
+    assert "tpu-verify clean: 40 programs" in res.stdout

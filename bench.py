@@ -310,6 +310,73 @@ def bench_resnet50_infer(on_tpu):
         f"dense_images_s={batch/dt_d:.0f} rel_err={err:.4f}")
 
 
+def bench_resnet50_train(on_tpu):
+    """ResNet-50 TRAINING through the fused Pallas conv suite
+    (ISSUE 16): the same TrainStep geometry as the tracked `resnet50`
+    row, run once with `conv_backend='dense'` (the composition the
+    0.152-MFU BENCH_r05 number and its ~0.20 perfect-fusion ceiling
+    were measured on) and once with `conv_backend='pallas'` (all 52
+    bottleneck/downsample convs through the fused custom_vjp — fused
+    forward epilogue stats AND fused dInput/dWeight backward).
+    First-step losses (identical weights, pre-update) are tolerance-
+    asserted before timing; the emitted metric is the FUSED images/s
+    with the dense number in the info line. Named-row only
+    (`BENCH_MODEL=resnet50_train`) so the committed BENCH_BASELINE
+    metric set is unchanged until a TPU `--save` refresh adopts it —
+    this is the row that shows whether training moved past the
+    fusion ceiling."""
+    import paddle_tpu as paddle
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        batch = int(os.environ.get("BENCH_BATCH", "256"))
+        size, classes = 224, 1000
+        steps = int(os.environ.get("BENCH_STEPS", "10"))
+        fwd_flops = RESNET50_FWD_FLOPS
+    else:
+        batch, size, classes, steps = 2, 32, 10, 2
+        fwd_flops = RESNET50_FWD_FLOPS * (32 / 224) ** 2
+
+    imgs_np = np.random.uniform(
+        -1, 1, (batch, 3, size, size)).astype(np.float32)
+    labels = paddle.to_tensor(
+        np.random.randint(0, classes, (batch,), np.int64))
+
+    def train(backend):
+        paddle.seed(0)                  # identical weights per build
+        model = resnet50(num_classes=classes, conv_backend=backend)
+        model.to(dtype="bfloat16")
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                        parameters=model.parameters())
+        step = jit.TrainStep(model, opt, F.cross_entropy)
+        imgs = paddle.to_tensor(imgs_np).astype("bfloat16")
+        t0 = time.time()
+        first = float(step(imgs, labels))     # compile + step 1
+        compile_s = time.time() - t0
+        dt, _, loss = _run_repeat_steps(step, imgs, labels, steps)
+        return first, float(loss), dt, compile_s
+
+    first_d, _, dt_d, _ = train("dense")
+    first_p, loss_p, dt_p, compile_s = train("pallas")
+    from bench_ops import CONV_FUSED_REL_TOL
+
+    err = abs(first_p - first_d) / max(abs(first_d), 1e-6)
+    assert err <= CONV_FUSED_REL_TOL, \
+        f"fused first-step loss diverged from dense ({err:.4f}, " \
+        f"budget {CONV_FUSED_REL_TOL})"
+    imgs_s = batch * steps / dt_p
+    return _emit(
+        "resnet50_train_fused_images_per_sec_per_chip", "images/s",
+        imgs_s, 3 * fwd_flops, on_tpu,
+        f"batch={batch} size={size} steps={steps} "
+        f"compile={compile_s:.1f}s step={dt_p/steps*1000:.1f}ms "
+        f"dense_step={dt_d/steps*1000:.1f}ms "
+        f"dense_images_s={batch*steps/dt_d:.0f} loss={loss_p:.3f} "
+        f"first_loss_rel_err={err:.4f}")
+
+
 def main():
     import jax
 
@@ -318,7 +385,8 @@ def main():
     which = os.environ.get("BENCH_MODEL", "all")
     table = {"gpt": bench_gpt, "bert": bench_bert,
              "resnet50": bench_resnet50,
-             "resnet50_infer": bench_resnet50_infer}
+             "resnet50_infer": bench_resnet50_infer,
+             "resnet50_train": bench_resnet50_train}
     if which == "all":
         # every BASELINE.md model row, one JSON line each — the GPT
         # flagship LAST so a last-line parser still reads the headline

@@ -107,6 +107,7 @@ SUITE_ROWS = (
     "gpt_engine_offered_load_int8", "gpt_fleet_offered_load",
     "gpt_engine_multitenant_lora", "gpt_engine_sampling",
     "conv_fused_sweep", "resnet50_fused_block",
+    "conv_fused_bwd_sweep", "resnet50_fused_block_train",
 )
 
 
@@ -216,6 +217,9 @@ def suite():
     cases["gpt_engine_sampling"] = _engine_sampling_case()
     cases["conv_fused_sweep"] = _conv_fused_sweep_case()
     cases["resnet50_fused_block"] = _resnet50_fused_block_case()
+    cases["conv_fused_bwd_sweep"] = _conv_fused_bwd_sweep_case()
+    cases["resnet50_fused_block_train"] = \
+        _resnet50_fused_block_train_case()
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -421,6 +425,21 @@ def _conv_rel_err(got, ref):
     return float(jnp.max(jnp.abs(g - r)) / denom)
 
 
+def _conv_rel_err_l2(got, ref):
+    """Relative L2 error — the GRADIENT metric: bf16 rounding feeds
+    sign-cancelling sums in dInput/dWeight, so per-element Linf
+    deviations run ~10x the aggregate error for BOTH the fused and
+    the dense backward (each sits the same L2 distance from the fp32
+    truth; DESIGN_DECISIONS r19). The Linf metric stays the forward
+    budget, where no cancellation exists."""
+    import jax.numpy as jnp
+
+    g = jnp.asarray(got, jnp.float32)
+    r = jnp.asarray(ref, jnp.float32)
+    denom = jnp.maximum(jnp.linalg.norm(r), 1e-6)
+    return float(jnp.linalg.norm(g - r) / denom)
+
+
 def _conv_fused_sweep_case(shapes=None, batch=32, dtype=None,
                            seed=23):
     """ISSUE-14 fused-conv microbench: every ResNet sweep shape run
@@ -548,6 +567,161 @@ def _resnet50_fused_block_case(batch=32, hw=56, inplanes=256,
                 "speedup_vs_dense": round(dense_ms / fused_ms, 3),
                 "tflops": round(flops / (fused_ms / 1e3) / 1e12, 2),
                 "rel_err": round(err, 5),
+                "batch": batch, "hw": hw}
+
+    return run_bench
+
+
+def _conv_fused_bwd_sweep_case(shapes=None, batch=32, dtype=None,
+                               seed=41):
+    """ISSUE-16 backward microbench: every ResNet sweep shape's full
+    train-mode grad program — forward + dInput + dWeight + BN-param
+    grads — run through BOTH paths: `jax.vjp` of the dense
+    differentiable composition (`conv_bn_relu_train_reference`, XLA's
+    best training-graph fusion, the ~0.20-MFU ceiling of the r5
+    probe) and the fused `custom_vjp` op (`fused_conv_bn_relu_train`:
+    stats-in-epilogue forward, two-pass Pallas backward). All four
+    gradients are tolerance-asserted in-runner before timing. FLOPs
+    count the three convolutions a grad step performs (fwd, dX, dW).
+    Headline `ms` is the fused grad time of the worst matmul-gap row
+    (conv_c2_1x1_64_256). Lazy-built; tests call it at tiny shapes
+    (the interpreter is the off-TPU path)."""
+
+    def run_bench():
+        import paddle_tpu  # noqa: F401  (registers pallas kernels)
+        from paddle_tpu.ops.pallas.conv import (
+            _on_tpu, conv_bn_relu_train_reference,
+            fused_conv_bn_relu_train)
+
+        if os.environ.get("PADDLE_CONV_BACKEND"):
+            raise RuntimeError(
+                "unset PADDLE_CONV_BACKEND to run the fused-vs-dense "
+                "bwd sweep")
+        dt = dtype or jnp.bfloat16
+        interpret = not _on_tpu()
+        rows = shapes or CONV_SWEEP_SHAPES
+        curves, head_ms = {}, None
+        for name, hw, cin, cout, k, s in rows:
+            x = _rand((batch, hw, hw, cin), dt,
+                      seed=zlib.crc32(name.encode()) % 83 + seed)
+            w = _rand((k, k, cin, cout), dt, seed=seed + 1) * 0.1
+            gamma = jnp.abs(_rand((cout,), jnp.float32,
+                                  seed=seed + 2)) + 0.5
+            beta = _rand((cout,), jnp.float32, seed=seed + 3)
+            ho = hw // s
+            # both paths emit the fp32-affine output dtype, so the
+            # incoming cotangent is fp32 for either
+            dy = _rand((batch, ho, ho, cout), jnp.float32,
+                       seed=seed + 4)
+
+            def make_grads(fn):
+                def run(a, b, g2, b2, ct):
+                    _, vjp = jax.vjp(lambda *ar: fn(*ar)[0],
+                                     a, b, g2, b2)
+                    return vjp(ct)
+                return jax.jit(run)
+
+            dense = make_grads(
+                lambda a, b, g2, b2, _s=s: conv_bn_relu_train_reference(
+                    a, b, g2, b2, stride=_s, padding="SAME"))
+            fused = make_grads(
+                lambda a, b, g2, b2, _s=s: fused_conv_bn_relu_train(
+                    a, b, g2, b2, stride=_s, padding="SAME",
+                    interpret=interpret))
+            ref = dense(x, w, gamma, beta, dy)
+            got = fused(x, w, gamma, beta, dy)
+            err = max(_conv_rel_err_l2(g, r)
+                      for g, r in zip(got, ref))
+            assert err <= CONV_FUSED_REL_TOL, \
+                (f"{name}: fused gradients diverge from the dense "
+                 f"composition (rel err {err:.4f}, budget "
+                 f"{CONV_FUSED_REL_TOL})")
+            dense_ms = _timeit(dense, x, w, gamma, beta, dy)
+            fused_ms = _timeit(fused, x, w, gamma, beta, dy)
+            flops = 3 * 2 * batch * ho * ho * cout * k * k * cin
+            curves[name] = {
+                "dense_ms": round(dense_ms, 4),
+                "fused_ms": round(fused_ms, 4),
+                "dense_tflops": round(flops / (dense_ms / 1e3) / 1e12,
+                                      2),
+                "fused_tflops": round(flops / (fused_ms / 1e3) / 1e12,
+                                      2),
+                "rel_err": round(err, 5)}
+            if head_ms is None or name == "conv_c2_1x1_64_256":
+                head_ms = fused_ms
+        return {"ms": round(head_ms, 4), "batch": batch,
+                "shapes": curves}
+
+    return run_bench
+
+
+def _resnet50_fused_block_train_case(batch=32, hw=56, inplanes=256,
+                                     planes=64, seed=43, steps=10):
+    """ISSUE-16 block-level training row: one ResNet-50 stage-2
+    BottleneckBlock run through a full compiled `jit.TrainStep`
+    (fwd + bwd + SGD update, one donated XLA program) with
+    `conv_backend='dense'` (today's training composition — the
+    hbm-roofline wall BENCH_r05 measured at 0.152 MFU) and
+    `conv_backend='pallas'` (all four conv+BN+ReLU stacks through the
+    fused custom_vjp, forward AND backward). Losses after one
+    identical-weights step are tolerance-asserted before timing; both
+    per-step times are recorded. This is the row structured to show
+    training moving past the ~0.20 fusion ceiling on the next TPU
+    `--save` refresh. fp32 (TrainStep's eager-parity dtype); the
+    full-model bf16 number is BENCH_MODEL=resnet50_train."""
+
+    def run_bench():
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.jit as jit
+        from paddle_tpu.vision.models.resnet import BottleneckBlock
+
+        if os.environ.get("PADDLE_CONV_BACKEND"):
+            raise RuntimeError(
+                "unset PADDLE_CONV_BACKEND to run the fused-vs-dense "
+                "train row")
+
+        xnp = np.random.RandomState(seed) \
+            .randn(batch, inplanes, hw, hw).astype(np.float32)
+        label = paddle.to_tensor(np.zeros(1, np.float32))
+
+        def build_step(backend):
+            paddle.seed(seed)            # identical weights per build
+            blk = BottleneckBlock(inplanes, planes,
+                                  conv_backend=backend)
+            blk.train()
+            opt = paddle.optimizer.SGD(
+                learning_rate=0.01, parameters=blk.parameters())
+            return jit.TrainStep(
+                blk, opt, loss_fn=lambda out, lbl: (out * out).mean())
+
+        def timed(step):
+            # TrainStep mutates parameters host-side between calls, so
+            # it cannot ride the fori_loop _timeit — wall-clock the
+            # donated program like bench.py's _run_repeat_steps
+            loss = float(step(paddle.to_tensor(xnp.copy()), label))
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                last = step(paddle.to_tensor(xnp.copy()), label)
+            float(last)                 # host sync
+            return loss, (time.perf_counter() - t0) / steps * 1e3
+
+        loss_d, dense_ms = timed(build_step("dense"))
+        loss_p, fused_ms = timed(build_step("pallas"))
+        err = abs(loss_p - loss_d) / max(abs(loss_d), 1e-6)
+        assert err <= CONV_FUSED_REL_TOL, \
+            (f"fused train step diverges from dense (loss rel err "
+             f"{err:.4f}, budget {CONV_FUSED_REL_TOL})")
+        width = planes
+        # 3x the forward conv flops (fwd, dInput, dWeight per conv)
+        flops = 3 * 2 * batch * hw * hw * (
+            inplanes * width + width * width * 9 + width * inplanes)
+        return {"ms": round(fused_ms, 4),
+                "dense_ms": round(dense_ms, 4),
+                "speedup_vs_dense": round(dense_ms / fused_ms, 3),
+                "tflops": round(flops / (fused_ms / 1e3) / 1e12, 2),
+                "loss_rel_err": round(err, 6),
                 "batch": batch, "hw": hw}
 
     return run_bench

@@ -9,10 +9,14 @@ write-back — behind the same `auto`/`dense`/`pallas` backend seam as
 paged attention (env override `PADDLE_CONV_BACKEND` wins, resolved
 ONCE at construction). The dense backend is byte-for-byte today's
 `nn_ops.conv2d` + `BatchNorm` + `relu` composition and stays the
-exactness foil; TRAINING always runs it (batch-stat BN needs the conv
-output twice and the tape needs a differentiable path — the fused
-kernel is forward-only), so the block computes the identical training
-graph and is a kernel upgrade for the serving/eval one. NOTE: the
+exactness foil. TRAINING on a pallas-resolved block runs fused too:
+`fused_conv_bn_relu_train` is a `jax.custom_vjp` whose forward fuses
+the batch-stat computation into the conv kernel's epilogue and whose
+backward runs the fused dInput/dWeight kernels — the block updates
+the BN running stats from the returned batch mean/var with exactly
+the `nn_ops.batch_norm` momentum rule. Dense-resolved training (and
+any geometry the train gate rejects — use_global_stats BN, untileable
+walks) keeps the identical pre-suite composition graph. NOTE: the
 refactor is graph-compatible, not checkpoint-key-compatible — resnet
 block state_dict keys moved from `conv1.weight`/`bn1.*` to
 `convbn1.conv.weight`/`convbn1.bn.*` (and `downsample.0.*` to
@@ -47,10 +51,14 @@ class ConvBNReLU(Layer):
     is `auto`/`dense`/`pallas` (default auto; `PADDLE_CONV_BACKEND`
     wins), resolved once here: unsupported geometries — the 7x7/s2
     stem, grouped/dilated convs, ragged channels — resolve `dense`
-    cleanly whatever was asked. The fused path engages only in eval
-    mode on a resolved-`pallas` block; everything else (training, the
-    dense backend, a custom norm layer) runs the composition the rest
-    of the framework already trains through."""
+    cleanly whatever was asked. On a resolved-`pallas` block the
+    fused kernels engage in BOTH modes: eval through the forward-only
+    folded-affine kernel, training through the `custom_vjp` batch-stat
+    op with fused backward. Everything else (the dense backend, a
+    custom norm layer, use_global_stats BN, a geometry either tile
+    gate rejects) runs the composition the rest of the framework
+    already trains through — `CONV_PATH_STATS` counts the train-mode
+    routes separately so a fallback is observable."""
 
     def __init__(self, in_channels, out_channels, kernel_size,
                  stride=1, padding=0, dilation=1, groups=1,
@@ -92,7 +100,8 @@ class ConvBNReLU(Layer):
         composition, unchanged (XLA fuses the element-wise tail)."""
         from paddle_tpu.ops.pallas.conv import CONV_PATH_STATS
 
-        CONV_PATH_STATS["dense"] += 1
+        CONV_PATH_STATS["dense_train" if self.training
+                        else "dense"] += 1
         out = self.conv(x)
         if not self._folded:
             out = self.bn(out)
@@ -103,24 +112,51 @@ class ConvBNReLU(Layer):
         return out
 
     def forward(self, x):
-        if (self.backend == "pallas" and not self.training
-                and not self._folded and self._geometry_tileable(x)):
-            return self._forward_fused(x)
+        if self.backend == "pallas" and not self._folded:
+            if not self.training and self._geometry_tileable(x):
+                return self._forward_fused(x)
+            if self.training and self._train_fusible(x):
+                return self._forward_fused_train(x)
         return self._compose(x)
 
     def _geometry_tileable(self, x):
         """The H/W-dependent half of the support gate, checked per
         forward (static resolution cannot see the input size): a
         geometry the 3x3 kernel cannot tile — too many row tiles, a
-        slab overrunning the padded input — runs the dense
-        composition, the same clean fallback as the static gate."""
+        slab overrunning the padded input or the VMEM budget — runs
+        the dense composition, the same clean fallback as the static
+        gate."""
         from paddle_tpu.ops.pallas.conv import conv_geometry_tileable
 
         hw = x.shape[2:4] if self._data_format == "NCHW" \
             else x.shape[1:3]
         return conv_geometry_tileable(self.conv._kernel_size,
                                       self.conv._stride,
-                                      self.conv._padding, in_hw=hw)
+                                      self.conv._padding, in_hw=hw,
+                                      in_channels=self.conv._in_channels)
+
+    def _train_fusible(self, x):
+        """Training-mode gate on a pallas-resolved block: batch-stat
+        BatchNorm only (`use_global_stats` pins running stats — the
+        fused train op computes batch stats by construction) and both
+        the forward AND backward walks must tile
+        (`conv_train_geometry_tileable`). Anything else runs the
+        dense composition — a clean fallback counted in
+        `CONV_PATH_STATS["dense_train"]`, never a silent
+        divergence."""
+        from paddle_tpu.ops.pallas.conv import \
+            conv_train_geometry_tileable
+
+        if not isinstance(self.bn, BatchNorm2D) or \
+                self.bn._use_global_stats:
+            return False
+        hw = x.shape[2:4] if self._data_format == "NCHW" \
+            else x.shape[1:3]
+        return conv_train_geometry_tileable(
+            self.conv._kernel_size, self.conv._stride,
+            self.conv._padding, in_hw=hw,
+            in_channels=self.conv._in_channels,
+            out_channels=self.conv._out_channels)
 
     def _forward_fused(self, x):
         """ONE dispatch: BN affine folded to (scale, shift) in fp32,
@@ -160,6 +196,61 @@ class ConvBNReLU(Layer):
                             self.conv.weight, self.bn.weight,
                             self.bn.bias, self.bn._mean,
                             self.bn._variance)
+
+    def _forward_fused_train(self, x):
+        """ONE differentiable dispatch for training: layouts swapped
+        to the kernels' NHWC, the `fused_conv_bn_relu_train`
+        custom_vjp (batch-stat forward with the stats fused into the
+        conv epilogue; fused dInput/dWeight backward), layouts swapped
+        back — through `apply`, so the tape (or an outer
+        value_and_grad) differentiates straight through the
+        custom_vjp. The BN running stats update from the returned
+        batch mean/var with exactly the `nn_ops.batch_norm` rule
+        (stop-gradient, unbiased variance, momentum)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.dispatch import apply
+        from paddle_tpu.ops.pallas.conv import _on_tpu, \
+            fused_conv_bn_relu_train
+
+        x = as_tensor(x)
+        eps = self.bn._epsilon
+        stride = self.conv._stride
+        padding = self.conv._padding
+        nchw = self._data_format == "NCHW"
+        relu = self._act == "relu"
+        interpret = not _on_tpu()
+
+        def fn(a, w, gamma, beta):
+            if nchw:
+                a = jnp.transpose(a, (0, 2, 3, 1))
+            wt = jnp.transpose(w, (2, 3, 1, 0))      # OIHW -> HWIO
+            y, mean, var = fused_conv_bn_relu_train(
+                a, wt, gamma, beta, stride=stride, padding=padding,
+                relu=relu, eps=eps, interpret=interpret)
+            if nchw:
+                y = jnp.transpose(y, (0, 3, 1, 2))
+            return y, mean, var
+
+        out, mean, var = apply("conv_bn_relu_fused_train", fn, x,
+                               self.conv.weight, self.bn.weight,
+                               self.bn.bias)
+        # running-stat update — the exact nn_ops.batch_norm side
+        # effect (under a compiled TrainStep the buffer assignment is
+        # captured and persisted like any in-forward buffer write)
+        bn = self.bn
+        rm, rv = bn._mean._array, bn._variance._array
+        os_ = out.shape
+        n = float(np.prod([os_[i] for i in ((0, 2, 3) if nchw
+                                            else (0, 1, 2))]))
+        unbiased = var._array * (n / max(n - 1.0, 1.0))
+        mom = bn._momentum
+        bn._mean._array = mom * rm + (1 - mom) * \
+            jax.lax.stop_gradient(mean._array)
+        bn._variance._array = mom * rv + (1 - mom) * \
+            jax.lax.stop_gradient(unbiased)
+        return out
 
     def fold(self):
         """Inference-time BN folding: absorb the running-stat affine

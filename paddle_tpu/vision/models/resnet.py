@@ -2,15 +2,19 @@
 PaddleClas ResNet-50 benchmark config, BASELINE.md). NCHW, BN layers;
 trains through jit.TrainStep on the MXU in bf16 via amp.auto_cast.
 
-The residual blocks are built from `nn.ConvBNReLU` (nn/fused.py):
-training forward is byte-for-byte the old conv -> BN -> ReLU
-composition, while EVAL forward can run each conv+BN+ReLU as ONE
-fused Pallas kernel (ops/pallas/conv.py) behind the `conv_backend`
-seam (`auto`/`dense`/`pallas`, env `PADDLE_CONV_BACKEND` wins) —
-the custom conv suite the ResNet MFU plateau called for. The 7x7/s2
-stem keeps the space-to-depth trick and stays a plain conv/BN pair
-(the fused suite covers the 1x1/3x3 bottleneck shapes; the stem
-resolves `dense` cleanly)."""
+The residual blocks are built from `nn.ConvBNReLU` (nn/fused.py)
+behind the `conv_backend` seam (`auto`/`dense`/`pallas`, env
+`PADDLE_CONV_BACKEND` wins) — the custom conv suite the ResNet MFU
+plateau called for. On a pallas-resolved block BOTH modes fuse: EVAL
+runs each conv+BN+ReLU as ONE folded-affine Pallas kernel, and
+TRAINING runs the batch-stat custom_vjp op (stats fused into the
+conv epilogue forward; fused dInput/dWeight kernels backward), so a
+resnet50 train step dispatches all 52 bottleneck/downsample convs
+through the fused path. Dense-resolved blocks keep byte-for-byte the
+old conv -> BN -> ReLU composition in both modes. The 7x7/s2 stem
+keeps the space-to-depth trick and stays a plain conv/BN pair (the
+fused suite covers the 1x1/3x3 bottleneck shapes; the stem resolves
+`dense` cleanly)."""
 from __future__ import annotations
 
 import paddle_tpu.nn as nn

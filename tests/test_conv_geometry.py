@@ -1,0 +1,90 @@
+"""Pure static-geometry contracts of the Pallas conv suite
+(ISSUE 16): the dInput row-grid rounding table, the train-mode
+tileability gate over every 3x3 geometry ResNet-50 actually runs
+(the contract behind the 52/52 fused-dispatch count), the H-tile
+divisor invariant, and the padding-normalization conventions. No
+jit, no kernels — these pin the gate logic the training seam and
+`tests/test_pallas_conv_bwd.py` rely on."""
+import pytest
+
+import paddle_tpu.ops.pallas.conv as C
+from paddle_tpu.ops.pallas.conv import conv_train_geometry_tileable
+
+
+@pytest.mark.parametrize("ho,expected", [
+    (1, 0), (8, 0), (16, 0), (120, 0), (128, 0),   # natural tilings
+    (17, 7), (29, 3), (58, 6),                     # prime-ish -> next 8
+    (126, 2), (127, 1),                            # at the ceiling
+    (130, None), (133, None),                      # past 128: dense
+])
+def test_dx_row_rounding_table(ho, expected):
+    """The dInput walk's row-grid round-up: 0 when the natural count
+    already tiles within the 16-tile unroll bound, else zero-rows up
+    to the next multiple of 8, None past the 128-row ceiling."""
+    assert C._dx_row_rounding(ho) == expected
+
+
+@pytest.mark.parametrize("ho", [1, 2, 7, 12, 17, 24, 56, 58, 128])
+def test_pick_h_tile_is_largest_divisor_leq_8(ho):
+    th = C._pick_h_tile(ho)
+    assert 1 <= th <= 8 and ho % th == 0
+    assert not any(ho % d == 0 for d in range(th + 1, 9))
+
+
+@pytest.mark.parametrize("hw,cin,cout,s", [
+    (56, 64, 64, 1),     # layer1 3x3
+    (56, 128, 128, 2),   # layer2 downsampling 3x3
+    (28, 128, 128, 1),
+    (28, 256, 256, 2),   # layer3 downsampling 3x3
+    (14, 256, 256, 1),
+    (14, 512, 512, 2),   # layer4 downsampling 3x3
+    (7, 512, 512, 1),
+    (32, 32, 32, 1),     # CIFAR-ish small inputs
+    (16, 32, 32, 2),
+])
+def test_resnet50_3x3_geometries_all_train_tileable(hw, cin, cout, s):
+    """Every 3x3 geometry a 224- or 32-input resnet50 actually runs
+    must pass the TRAIN gate — this is the fusability contract the
+    52/52 dispatch count in the train-step test rests on."""
+    assert conv_train_geometry_tileable(3, s, 1, in_hw=(hw, hw),
+                                        in_channels=cin,
+                                        out_channels=cout)
+
+
+@pytest.mark.parametrize("hw,s", [(34, 1), (130, 1), (129, 1)])
+def test_untileable_3x3_geometries_gate_false(hw, s):
+    """Row grids with no divisor <= 8 inside the unroll bound and no
+    round-up inside the 128-row ceiling must gate False (the block
+    seam then trains dense)."""
+    assert not conv_train_geometry_tileable(3, s, 1, in_hw=(hw, hw),
+                                            in_channels=8,
+                                            out_channels=8)
+
+
+@pytest.mark.parametrize("k,s", [(1, 1), (1, 2)])
+def test_1x1_family_always_train_tileable(k, s):
+    for hw in (1, 2, 7, 56, 224, 1024):
+        assert conv_train_geometry_tileable(k, s, 0, in_hw=(hw, hw))
+
+
+@pytest.mark.parametrize("padding,kernel,stride,in_hw,expected", [
+    (0, 3, 1, None, ((0, 0), (0, 0))),
+    (1, 3, 1, None, ((1, 1), (1, 1))),
+    ((1, 2), 3, 1, None, ((1, 1), (2, 2))),
+    ((1, 2, 3, 4), 3, 1, None, ((1, 2), (3, 4))),
+    (((0, 1), (2, 3)), 3, 1, None, ((0, 1), (2, 3))),
+    ("VALID", 3, 1, None, ((0, 0), (0, 0))),
+    ("SAME", 3, 1, (16, 16), ((1, 1), (1, 1))),
+    ("SAME", 3, 2, (16, 16), ((0, 1), (0, 1))),
+])
+def test_normalize_conv_padding_conventions(padding, kernel, stride,
+                                            in_hw, expected):
+    assert C.normalize_conv_padding(padding, kernel, stride,
+                                    in_hw=in_hw) == expected
+
+
+@pytest.mark.parametrize("bad", ["SAME", "circular", (1, 2, 3)])
+def test_normalize_conv_padding_rejects(bad):
+    # "SAME" without in_hw, unknown strings, and odd-length tuples
+    with pytest.raises(ValueError):
+        C.normalize_conv_padding(bad, 3, 2, in_hw=None)

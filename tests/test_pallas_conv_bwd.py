@@ -1,0 +1,352 @@
+"""Fused Pallas conv training suite (ISSUE 16): interpreter-mode
+gradient parity of the `fused_conv_bn_relu_train` custom_vjp op vs
+`jax.vjp` of the dense differentiable composition
+(`conv_bn_relu_train_reference`) across the nine ResNet-50 sweep
+shapes and both strides, the stride/ReLU/dtype matrix, forced
+W-tiling, the ConvBNReLU training seam (running stats, dense
+fallback bit-identity, use_global_stats), the resnet50 train-step
+dispatch count, and the ISSUE-16 bench runners at tiny shapes.
+
+Gradient checks flow a fixed random cotangent through `jax.vjp` of
+the y output only — the mean/var outputs feed stop-gradient
+consumers in the block (running-stat updates), which is exactly how
+the op is differentiated in a train step."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.ops.pallas.conv as C
+from paddle_tpu.ops.pallas.conv import (
+    CONV_PATH_STATS, conv_bn_relu_train_reference,
+    conv_train_geometry_tileable, fused_conv_bn_relu_train,
+    reset_conv_path_stats,
+)
+
+import bench_ops
+
+SWEEP = list(bench_ops.CONV_SWEEP_SHAPES)
+assert len(SWEEP) == 9
+
+# ISSUE-16 stated budgets: fp32 near-exact in Linf (~1e-5 — only
+# reduction order differs; both paths accumulate fp32); bf16 within
+# the bench budget in relative L2 — the gradient metric: bf16
+# rounding feeds sign-cancelling sums in dInput/dWeight, so Linf
+# deviations run ~10x the aggregate error for the DENSE backward
+# too (both paths sit the same L2 distance from the fp32 truth;
+# DESIGN_DECISIONS r19, bench_ops._conv_rel_err_l2)
+FP32_GRAD_TOL = 1e-5
+BF16_GRAD_TOL = bench_ops.CONV_FUSED_REL_TOL
+
+
+def _rel_err(got, ref):
+    g = np.asarray(got, np.float32)
+    r = np.asarray(ref, np.float32)
+    return np.max(np.abs(g - r)) / max(np.max(np.abs(r)), 1e-6)
+
+
+def _rel_err_l2(got, ref):
+    g = np.asarray(got, np.float32)
+    r = np.asarray(ref, np.float32)
+    return np.linalg.norm(g - r) / max(np.linalg.norm(r), 1e-6)
+
+
+def _case(hw, cin, cout, k, s, dtype, n=1, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(n, hw, hw, cin).astype(np.float32)) \
+        .astype(dtype)
+    w = jnp.asarray((rng.randn(k, k, cin, cout) * 0.1)
+                    .astype(np.float32)).astype(dtype)
+    gamma = jnp.asarray((rng.rand(cout) + 0.5).astype(np.float32))
+    beta = jnp.asarray(rng.randn(cout).astype(np.float32))
+    ho = (hw + s - 1) // s
+    dy = jnp.asarray(rng.randn(n, ho, ho, cout).astype(np.float32))
+    return x, w, gamma, beta, dy
+
+
+def _grads(fn, x, w, gamma, beta, dy):
+    """(y, mean, var, dx, dw, dgamma, dbeta) of the y-only vjp: the
+    mean/var cotangents are zero, as in a real train step."""
+    (y, mean, var), vjp = jax.vjp(fn, x, w, gamma, beta)
+    return (y, mean, var) + vjp(
+        (dy, jnp.zeros_like(mean), jnp.zeros_like(var)))
+
+
+def _check_grads(hw, cin, cout, k, s, dtype, tol, relu=True, n=1,
+                 padding="SAME", seed=0):
+    x, w, gamma, beta, dy = _case(hw, cin, cout, k, s, dtype, n=n,
+                                  seed=seed)
+    got = _grads(
+        lambda *a: fused_conv_bn_relu_train(*a, stride=s,
+                                            padding=padding,
+                                            relu=relu, interpret=True),
+        x, w, gamma, beta, dy)
+    ref = _grads(
+        lambda *a: conv_bn_relu_train_reference(*a, stride=s,
+                                                padding=padding,
+                                                relu=relu),
+        x, w, gamma, beta, dy)
+    labels = ("y", "mean", "var", "dx", "dw", "dgamma", "dbeta")
+    metric = _rel_err if dtype == jnp.float32 else _rel_err_l2
+    for name, g, r in zip(labels, got, ref):
+        assert g.shape == r.shape and g.dtype == r.dtype, name
+        err = metric(g, r)
+        assert err <= tol, f"{name}: rel err {err:.2e} > {tol}"
+
+
+@pytest.mark.parametrize("name,hw,cin,cout,k,s", SWEEP,
+                         ids=[r[0] for r in SWEEP])
+def test_bwd_sweep_grad_parity_fp32(name, hw, cin, cout, k, s):
+    """Acceptance: every sweep shape at its native stride, all
+    gradients of the fused custom_vjp vs the dense composition, fp32
+    under the CPU interpreter (the forward suite's tiering: the
+    forced-other-stride matrix rides the slow tier below)."""
+    _check_grads(hw, cin, cout, k, s, jnp.float32, FP32_GRAD_TOL)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name,hw,cin,cout,k,s", SWEEP,
+                         ids=[r[0] for r in SWEEP])
+def test_bwd_sweep_grad_parity_fp32_both_strides(name, hw, cin, cout,
+                                                 k, s):
+    """Acceptance: every sweep shape at BOTH strides, all gradients of
+    the fused custom_vjp vs the dense composition, fp32 under the CPU
+    interpreter (1x1/s2 skips odd hw — the downsample slice needs an
+    even grid, matching the forward matrix)."""
+    for stride in (1, 2):
+        if k == 1 and stride == 2 and hw % 2:
+            continue
+        if not conv_train_geometry_tileable(k, stride, "SAME",
+                                            in_hw=(hw, hw),
+                                            in_channels=cin,
+                                            out_channels=cout):
+            # the forced non-native stride can push the mirrored dX
+            # walk past the row-tile bound (e.g. 28x28/s2 -> a prime
+            # 29-row grid): the block seam resolves such configs
+            # dense; the raw op must reject them loudly
+            x, w, gamma, beta, _ = _case(hw, cin, cout, k, stride,
+                                         jnp.float32)
+            with pytest.raises(ValueError, match="dense composition"):
+                fused_conv_bn_relu_train(x, w, gamma, beta,
+                                         stride=stride, padding="SAME",
+                                         interpret=True)
+            continue
+        _check_grads(hw, cin, cout, k, stride, jnp.float32,
+                     FP32_GRAD_TOL)
+
+
+@pytest.mark.parametrize("k,cin,cout", [(1, 32, 64), (3, 32, 32)])
+@pytest.mark.parametrize("s", [1, 2])
+@pytest.mark.parametrize("relu", [True, False])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_bwd_stride_relu_dtype_matrix(k, cin, cout, s, relu, dtype):
+    """Both kernel families x stride {1,2} x {with,without ReLU} x
+    {fp32, bf16} at a small shape — the relu mask gates dz, so the
+    no-relu branch exercises a genuinely different backward."""
+    tol = FP32_GRAD_TOL if dtype == jnp.float32 else BF16_GRAD_TOL
+    # 16x16/s2/"SAME" also exercises the dX row-grid rounding (a
+    # prime 17-row walk padded up to 24)
+    _check_grads(16, cin, cout, k, s, dtype, tol, relu=relu, n=2)
+
+
+@pytest.mark.slow
+def test_bwd_padding_and_odd_geometries():
+    """Symmetric padding=1 at stride 2 over odd hw dilates dOut into
+    an under-covering grid (the zero-pad completion path), and the
+    asymmetric "SAME" halo rides the mirrored tap walk — both must
+    match the dense vjp."""
+    for hw in (7, 9):
+        _check_grads(hw, 16, 16, 3, 2, jnp.float32, FP32_GRAD_TOL,
+                     padding=1)
+    _check_grads(14, 16, 16, 3, 2, jnp.float32, FP32_GRAD_TOL,
+                 padding="SAME")
+    _check_grads(4, 16, 16, 3, 1, jnp.float32, FP32_GRAD_TOL,
+                 padding=1)
+
+
+@pytest.mark.slow
+def test_wtiled_geometry_grad_parity():
+    """Forcing a tiny VMEM budget splits the 3x3 row slab into W
+    tiles (ISSUE-16: resolutions that used to fall back dense become
+    tileable) — the tiled walk must stay grad-exact. The cached vjp
+    builders capture geometry, so the cache is cleared around the
+    budget override."""
+    old = C._VMEM_SLAB_BYTES
+    try:
+        C._VMEM_SLAB_BYTES = 16 * 1024
+        C._train_vjp.cache_clear()
+        geo = C._conv3x3_geometry(20, 20, 16)
+        assert geo is not None and geo[8] > 1, \
+            "budget override must actually force W-tiling"
+        _check_grads(20, 16, 16, 3, 1, jnp.float32, FP32_GRAD_TOL,
+                     padding=1)
+        _check_grads(20, 16, 16, 3, 2, jnp.float32, FP32_GRAD_TOL,
+                     padding="SAME")
+    finally:
+        C._VMEM_SLAB_BYTES = old
+        C._train_vjp.cache_clear()
+    assert C._conv3x3_geometry(20, 20, 16)[8] == 1
+
+
+def test_train_geometry_gate_and_loud_rejection():
+    """`conv_train_geometry_tileable` ANDs the forward gate with the
+    backward dX walk's own tileability (its row grid rounds up to a
+    tileable count — the ResNet stage-1 56x56 class trains fused);
+    calling the train op on an unsupported shape is a loud
+    ValueError, never silence."""
+    assert conv_train_geometry_tileable(1, 1, 0, in_hw=(34, 34),
+                                        in_channels=8, out_channels=8)
+    assert not conv_train_geometry_tileable(3, 1, 1, in_hw=(34, 34),
+                                            in_channels=8,
+                                            out_channels=8)
+    assert conv_train_geometry_tileable(3, 1, 1, in_hw=(32, 32),
+                                        in_channels=8, out_channels=8)
+    assert conv_train_geometry_tileable(3, 1, 1, in_hw=(56, 56),
+                                        in_channels=64,
+                                        out_channels=64)
+    # forward-tileable but past the 128-row dX rounding ceiling:
+    # the TRAIN gate alone says dense (eval still fuses)
+    from paddle_tpu.ops.pallas.conv import conv_geometry_tileable
+
+    assert conv_geometry_tileable(3, 1, 1, in_hw=(128, 128))
+    assert not conv_train_geometry_tileable(3, 1, 1, in_hw=(128, 128),
+                                            in_channels=8,
+                                            out_channels=8)
+    with pytest.raises(ValueError, match="dense composition"):
+        fused_conv_bn_relu_train(jnp.zeros((1, 16, 16, 3)),
+                                 jnp.zeros((7, 7, 3, 64)),
+                                 jnp.ones(64), jnp.zeros(64),
+                                 stride=2, padding=3, interpret=True)
+
+
+def test_convbnrelu_train_running_stats_and_grad_parity():
+    """The block-level training seam: a pallas-resolved ConvBNReLU in
+    train mode dispatches the fused op (counted under `pallas_train`),
+    matches the dense block's output AND parameter gradients, and
+    updates the BN running mean/variance identically (momentum rule,
+    unbiased variance)."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    blk_p = nn.ConvBNReLU(16, 32, 3, padding=1, backend="pallas")
+    paddle.seed(0)
+    blk_d = nn.ConvBNReLU(16, 32, 3, padding=1, backend="dense")
+    blk_p.train()
+    blk_d.train()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 16, 8, 8).astype(np.float32))
+    reset_conv_path_stats()
+    out_p = blk_p(x)
+    assert CONV_PATH_STATS["pallas_train"] == 1
+    assert CONV_PATH_STATS["dense_train"] == 0
+    out_d = blk_d(x)
+    assert CONV_PATH_STATS["dense_train"] == 1
+    assert _rel_err(out_p.numpy(), out_d.numpy()) <= FP32_GRAD_TOL
+    (out_p * out_p).mean().backward()
+    (out_d * out_d).mean().backward()
+    for p, d in ((blk_p.conv.weight, blk_d.conv.weight),
+                 (blk_p.bn.weight, blk_d.bn.weight),
+                 (blk_p.bn.bias, blk_d.bn.bias)):
+        assert p.grad is not None
+        assert _rel_err(p.grad.numpy(), d.grad.numpy()) <= FP32_GRAD_TOL
+    assert _rel_err(blk_p.bn._mean.numpy(),
+                    blk_d.bn._mean.numpy()) <= FP32_GRAD_TOL
+    assert _rel_err(blk_p.bn._variance.numpy(),
+                    blk_d.bn._variance.numpy()) <= FP32_GRAD_TOL
+
+
+def test_train_fallbacks_stay_bit_identical_to_composition():
+    """Dense-resolved training configs must stay BIT-identical to the
+    pre-suite composition: an untileable train geometry (34x34 3x3)
+    and a use_global_stats BN both route a pallas-resolved block
+    through `_compose` (counted under `dense_train`), byte-for-byte
+    the dense backend's output."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    blk_p = nn.ConvBNReLU(8, 8, 3, padding=1, backend="pallas")
+    paddle.seed(0)
+    blk_d = nn.ConvBNReLU(8, 8, 3, padding=1, backend="dense")
+    blk_p.train()
+    blk_d.train()
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, 8, 34, 34).astype(np.float32))
+    reset_conv_path_stats()
+    out = blk_p(x)                        # must not raise
+    assert CONV_PATH_STATS["dense_train"] == 1
+    assert CONV_PATH_STATS["pallas_train"] == 0
+    np.testing.assert_array_equal(out.numpy(), blk_d(x).numpy())
+
+    # frozen-stats BN is eval-normalization inside a train-mode
+    # block: not the batch-stat op's contract -> composition
+    paddle.seed(0)
+    blk_g = nn.ConvBNReLU(16, 16, 3, padding=1, backend="pallas")
+    blk_g.bn._use_global_stats = True
+    blk_g.train()
+    x2 = paddle.to_tensor(np.random.RandomState(2)
+                          .randn(1, 16, 8, 8).astype(np.float32))
+    reset_conv_path_stats()
+    blk_g(x2)
+    assert CONV_PATH_STATS["dense_train"] == 1
+    assert CONV_PATH_STATS["pallas_train"] == 0
+
+
+@pytest.mark.slow
+def test_resnet50_train_step_fused_dispatch_and_parity():
+    """Acceptance: a compiled resnet50 TrainStep through the pallas
+    backend dispatches all 52 bottleneck/downsample convs through the
+    fused custom_vjp (counted at trace time) and its loss matches the
+    dense backend's step on identical weights."""
+    import paddle_tpu.jit as jit
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    # 64x64 keeps layer4's feature maps at 2x2 so its batch-stat BN
+    # normalizes over M=16 samples.  At 32x32 the maps collapse to 1x1
+    # (M=batch) and BN turns the net chaotic: eager-dense vs
+    # compiled-dense alone then disagree by O(1) in loss, so no loss
+    # tolerance is meaningful there for ANY backend pairing.
+    xnp = np.random.RandomState(3) \
+        .uniform(-1, 1, (4, 3, 64, 64)).astype(np.float32)
+    lbl = paddle.to_tensor(np.random.RandomState(4)
+                           .randint(0, 10, (4,), np.int64))
+
+    def one_step(backend):
+        paddle.seed(0)
+        model = resnet50(num_classes=10, conv_backend=backend)
+        model.train()
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=model.parameters())
+        step = jit.TrainStep(model, opt, F.cross_entropy)
+        return float(step(paddle.to_tensor(xnp.copy()), lbl))
+
+    loss_d = one_step("dense")
+    reset_conv_path_stats()
+    loss_p = one_step("pallas")
+    # 16 blocks x 3 convs + 4 downsamples, counted during the trace
+    assert CONV_PATH_STATS["pallas_train"] == 52
+    # ~1e-4 observed: fp32 rounding differences between the fused and
+    # composed graphs, amplified once per BN by 1/sigma over 53 layers
+    assert abs(loss_p - loss_d) / max(abs(loss_d), 1e-6) <= 1e-3
+
+
+@pytest.mark.slow
+def test_bwd_bench_runners_tiny():
+    """Both ISSUE-16 lazy bench runners execute end-to-end at tiny
+    shapes with their in-runner tolerance asserts live."""
+    rec = bench_ops._conv_fused_bwd_sweep_case(
+        shapes=(("conv_c2_1x1_64_256", 8, 16, 32, 1, 1),
+                ("conv_c4_3x3_256_s2", 8, 16, 16, 3, 2)), batch=2)()
+    assert set(rec["shapes"]) == {"conv_c2_1x1_64_256",
+                                  "conv_c4_3x3_256_s2"}
+    for curves in rec["shapes"].values():
+        assert curves["rel_err"] <= bench_ops.CONV_FUSED_REL_TOL
+    rec = bench_ops._resnet50_fused_block_train_case(
+        batch=2, hw=8, inplanes=32, planes=8, steps=2)()
+    assert rec["loss_rel_err"] <= bench_ops.CONV_FUSED_REL_TOL
+    assert rec["dense_ms"] > 0 and rec["ms"] > 0
+

@@ -76,18 +76,21 @@ def test_matrix_is_contract_clean(matrix_result):
     # sampled decode + both sampled prefills, and the composed
     # pallas/K=4/mp=2/int8 rejection-sampling verify step) — plus the
     # 4 PR-14 fused Pallas conv programs (both kernel families x
-    # stride)
-    assert len(res.programs) == 40
+    # stride) — plus the 4 PR-16 backward programs (the train-mode
+    # custom_vjp grad jaxprs, both families x stride; TPU103 must
+    # walk the fused dInput/dWeight kernels too)
+    assert len(res.programs) == 44
     assert sum(",int8" in p.config for p in res.programs) == 16
     assert sum(",lora" in p.config for p in res.programs) == 4
     assert sum(",sampling" in p.config for p in res.programs) == 4
     assert sum(p.contract.name.startswith("conv_bn_relu")
-               for p in res.programs) == 4
+               for p in res.programs) == 8
     names = {p.contract.name for p in res.programs}
     assert names == {"engine_decode_step", "engine_verify_step",
                      "engine_prefill", "engine_prefill_chunk",
                      "engine_cow_copy", "conv_bn_relu_1x1",
-                     "conv_bn_relu_3x3"}
+                     "conv_bn_relu_3x3", "conv_bn_relu_1x1_bwd",
+                     "conv_bn_relu_3x3_bwd"}
     assert res.stale_trace_baseline == []
 
 
@@ -252,4 +255,4 @@ def test_cli_acceptance_command_exits_zero():
         [sys.executable, os.path.join(REPO, "tools", "tpu_verify.py")],
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stdout + res.stderr
-    assert "tpu-verify clean: 40 programs" in res.stdout
+    assert "tpu-verify clean: 44 programs" in res.stdout

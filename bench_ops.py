@@ -108,6 +108,7 @@ SUITE_ROWS = (
     "gpt_engine_multitenant_lora", "gpt_engine_sampling",
     "conv_fused_sweep", "resnet50_fused_block",
     "conv_fused_bwd_sweep", "resnet50_fused_block_train",
+    "gpt_engine_host_gap",
 )
 
 
@@ -220,6 +221,7 @@ def suite():
     cases["conv_fused_bwd_sweep"] = _conv_fused_bwd_sweep_case()
     cases["resnet50_fused_block_train"] = \
         _resnet50_fused_block_train_case()
+    cases["gpt_engine_host_gap"] = _engine_host_gap_case()
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -1646,6 +1648,109 @@ def _engine_sampling_case(model_cfg=None, num_requests=12,
                 "sampled_tokens": sampled,
                 "best_of_n_hit_tokens": int(
                     bo.prefix_hit_tokens - hit0),
+                "requests": num_requests}
+
+    return run_bench
+
+
+def _engine_host_gap_case(model_cfg=None, num_requests=12,
+                          num_slots=4, block_size=16, max_new=32,
+                          seed=0):
+    """Host-gap row (ISSUE 17 — ROADMAP item 3's measured baseline):
+    the offered-load trace served on a tracing-enabled engine at
+    K in {0, 4}, cold (first serve after construction — compiles land
+    in the dispatch phase) and warm (metrics reset, second serve).
+    The tracked numbers are host-gap milliseconds per step BY PHASE
+    (schedule/prefix_lookup/dispatch/device_wait/draft_propose/
+    accept_walk/cow/finish — the `engine_step_host_gap_seconds`
+    histogram, sum/count per phase) plus the device fraction
+    (device_wait over the phase total), i.e. how much of every
+    scheduler iteration is serial host work the async core of ROADMAP
+    item 3 could overlap. On CPU the fraction is meaningless as an
+    absolute; the row exists so a TPU `--save` pins the baseline the
+    overlap claim is measured against."""
+
+    def run_bench():
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.inference import GenerationEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        # prompt + budget must fit the model window (tiny CI configs)
+        hi = min(97, cfg.max_seq_len - max_new)
+        lo = min(16, hi - 1)
+        reqs = [rng.randint(0, cfg.vocab_size,
+                            rng.randint(lo, hi)).astype(np.int32)
+                for _ in range(num_requests)]
+        model = GPTForCausalLM(cfg)
+        model.eval()
+
+        def build(k):
+            engine = GenerationEngine(model, num_slots=num_slots,
+                                      block_size=block_size,
+                                      spec_decode_k=k, tracing=True)
+            if not engine.tracing:
+                # a host-gap row without its spans/phases is a
+                # different measurement — never record it as this one
+                raise RuntimeError(
+                    "bench row requested tracing=True but the engine "
+                    "resolved tracing off (is PADDLE_SERVE_TRACING "
+                    "set?) — unset it to run this row")
+            return engine
+
+        def serve(engine):
+            base = engine.tokens_generated
+            t0 = time.perf_counter()
+            for p in reqs:
+                engine.add_request(p, max_new_tokens=max_new)
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            assert len(out) == num_requests
+            return dt, engine.tokens_generated - base
+
+        def phase_report(engine):
+            """(phase -> ms/step, device fraction) from the host-gap
+            histogram accumulated since the last metrics reset."""
+            snap = engine.metrics_snapshot()
+            series = snap["engine_step_host_gap_seconds"]["series"]
+            per_step, sums = {}, {}
+            for s in series:
+                if not s["count"]:
+                    continue
+                ph = s["labels"]["phase"]
+                sums[ph] = s["sum"]
+                per_step[ph] = round(s["sum"] / s["count"] * 1e3, 4)
+            total = sum(sums.values())
+            frac = round(sums.get("device_wait", 0.0) / total, 4) \
+                if total else 0.0
+            return per_step, frac
+
+        rec = {}
+        for k in (0, 4):
+            eng = build(k)
+            dt_cold, toks_cold = serve(eng)       # includes compiles
+            cold, frac_cold = phase_report(eng)
+            eng.metrics.reset()
+            dt_warm, toks_warm = serve(eng)
+            warm, frac_warm = phase_report(eng)
+            rec[f"k{k}"] = {
+                "phase_ms_per_step_cold": cold,
+                "phase_ms_per_step_warm": warm,
+                "device_fraction_cold": frac_cold,
+                "device_fraction_warm": frac_warm,
+                "tokens_per_s_warm": round(toks_warm / dt_warm),
+                "spans": int(eng.tracer.total_recorded),
+            }
+            if k == 0:
+                ms_warm = dt_warm * 1e3
+        return {"ms": round(ms_warm, 1), **rec,
                 "requests": num_requests}
 
     return run_bench

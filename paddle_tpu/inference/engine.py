@@ -189,6 +189,7 @@ import math
 import os
 import time
 from collections import OrderedDict, deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -206,6 +207,11 @@ from paddle_tpu.jit.api import bound_state, count_traces, dedup_params, \
     model_buffers
 from paddle_tpu.observability.metrics import LATENCY_BUCKETS, \
     MetricsRegistry
+from paddle_tpu.observability.tracing import (FlightRecorder,
+                                              PhaseTimer, TraceRecorder,
+                                              export_timeline,
+                                              new_trace_id, now_us,
+                                              profiler_host_events)
 from paddle_tpu.profiler import RecordEvent
 
 __all__ = ["PagedKVCache", "GenerationEngine", "Request",
@@ -405,6 +411,9 @@ class PagedKVCache:
         # refcount-zero cached blocks, LRU order (oldest first): the
         # reclaimable tail of the prefix cache
         self._evictable = OrderedDict()   # block id -> chain hash
+        # optional observer called with each block id the allocator
+        # reclaims from the prefix cache (engine flight recorder)
+        self.on_evict = None
 
     def pool_spec(self):
         """The ONE source of truth for a pool plane's logical
@@ -473,6 +482,10 @@ class PagedKVCache:
             del self._block_of[h]
             del self._hash_of[block]
             got.append(block)
+            if self.on_evict is not None:
+                # observability hook (engine flight recorder): a warm
+                # prefix block just lost its cached content
+                self.on_evict(block)
         for b in got:
             self._ref[b] = 1
         if got and self.scales is not None:
@@ -624,6 +637,11 @@ class Request:
     # probabilistic serving: the request's SamplingParams (seed already
     # resolved at intake), or None for the greedy/argmax contract
     sampling: object = None
+    # request-scoped tracing: the id every span this request produces
+    # carries — minted at intake (engine or fleet) and riding the
+    # disaggregated handoff, so one timeline follows the request
+    # across replicas. None on a tracing-disabled engine.
+    trace_id: object = None
 
 
 @dataclass(eq=False)
@@ -692,7 +710,9 @@ class GenerationEngine:
                  max_queue=None, spec_decode_k=0, drafter=None,
                  mesh=None, mp_degree=None, kv_dtype=None,
                  weight_dtype=None, adapters=None,
-                 adapter_pool_pages=None, sampling=None):
+                 adapter_pool_pages=None, sampling=None,
+                 tracing=None, trace_capacity=4096,
+                 flight_capacity=256):
         from paddle_tpu.ops.paged_attention import (copy_pool_block,
                                                     resolve_backend)
 
@@ -762,6 +782,21 @@ class GenerationEngine:
         self.sampling = self._resolve_bool_knob(
             "PADDLE_SERVE_SAMPLING", sampling)
         self._seed_counter = 0
+        # request-scoped tracing (PR 17): host-side spans ONLY — no
+        # tracing state ever becomes a compiled-program argument, so a
+        # tracing-enabled engine runs byte-identical programs to a
+        # disabled one (the sampling=False precedent, held trivially
+        # by construction). Env override wins (deploy-time knob).
+        self.tracing = self._resolve_bool_knob(
+            "PADDLE_SERVE_TRACING", tracing)
+        self.tracer = TraceRecorder(capacity=trace_capacity) \
+            if self.tracing else None
+        # the flight recorder and the step-phase clock are ALWAYS on:
+        # both are bounded host-side bookkeeping (a few appends /
+        # perf_counter calls per step) and they feed the always-on
+        # leak-audit postmortem and host-gap histograms
+        self.flight = FlightRecorder(capacity=flight_capacity)
+        self._phases = PhaseTimer()
         # default pool covers every slot at full context (+ null block):
         # correctness-first; serving deployments size it to live-context
         # expectations and lean on the stall/retry path under pressure
@@ -772,6 +807,8 @@ class GenerationEngine:
             cfg.hidden_size // cfg.num_heads,
             dtype=model.gpt.wte.weight._array.dtype, mesh=self.mesh,
             kv_dtype=self.kv_dtype)
+        self.cache.on_evict = lambda b: self.flight.record(
+            "prefix_evict", block=b)
         # multi-tenant adapter serving (paged batched-LoRA): an
         # AdapterRegistry (or a prebuilt PagedAdapterPool) turns on
         # per-slot adapter ids through every compiled step. None (the
@@ -1507,6 +1544,38 @@ class GenerationEngine:
             buckets=LATENCY_BUCKETS).labels(
                 backend=self.attention_backend)
         self._decode_traces_seen = 0
+        # step-phase decomposition (ISSUE 17 / ROADMAP item 3): the
+        # host work between compiled steps, per named phase — the
+        # measured baseline the async engine core must beat. Always
+        # registered: the phase clock is host bookkeeping, on for
+        # every engine (tracing only adds the span stream).
+        self._m_host_gap = m.histogram(
+            "engine_step_host_gap_seconds",
+            "Exclusive wall time one engine.step() spent in each named "
+            "host phase (device_wait is the block_until_ready wait — "
+            "the only phase that is device time; everything else is "
+            "the serial host gap ROADMAP item 3 wants overlapped).",
+            labelnames=("phase",), buckets=LATENCY_BUCKETS)
+        self._m_device_fraction = m.gauge(
+            "engine_step_device_fraction",
+            "Fraction of the last step's wall time spent waiting on "
+            "the device (device_wait / step wall): 1.0 = device-bound "
+            "(host gap hidden), small = host-serial tax dominates.")
+        # trace-count series: registered only when tracing is on, so a
+        # plain engine's exposition is unchanged (adapter precedent)
+        self._m_trace_spans = None
+        if self.tracing:
+            self._m_trace_spans = m.counter(
+                "engine_trace_spans_total",
+                "Spans/instants this engine's trace ring recorded "
+                "(ring-bounded retention; see "
+                "engine_trace_dropped_total).")
+            self._m_trace_dropped = m.counter(
+                "engine_trace_dropped_total",
+                "Trace events evicted by the bounded span ring — "
+                "nonzero means the exported timeline is a tail, not "
+                "the full history.")
+            self._trace_spans_seen = self._trace_dropped_seen = 0
         # multi-tenant adapter serving: per-TENANT latency series plus
         # adapter-pool paging health. Registered only when the
         # subsystem is on, so a plain engine's exposition is unchanged.
@@ -1603,6 +1672,83 @@ class GenerationEngine:
     def metrics_snapshot(self):
         """JSON-able snapshot of this engine's serving metrics."""
         return self.metrics.snapshot()
+
+    # -- request-scoped tracing / step phases ------------------------------
+    def _phase(self, name):
+        """Enter one named host phase of the current step (exclusive
+        accounting — nesting pauses the enclosing phase) and, with
+        tracing on, record it as a span."""
+        if self.tracer is None:
+            return self._phases.phase(name)
+        return self._traced_phase(name)
+
+    @contextmanager
+    def _traced_phase(self, name):
+        t0 = now_us()
+        with self._phases.phase(name):
+            yield
+        self.tracer.add_span("phase." + name, t0, now_us(),
+                             cat="phase")
+
+    def _trace_span(self, name, start_us, req=None, tid=0,
+                    cat="request", **attrs):
+        """Close a request-scoped span started at `start_us` (no-op
+        with tracing off or an untraced request)."""
+        if self.tracer is None:
+            return
+        self.tracer.add_span(
+            name, start_us, now_us(), tid=tid, cat=cat,
+            trace_id=None if req is None else req.trace_id,
+            args={"req_id": str(req.req_id), **attrs} if req is not None
+            else (attrs or None))
+
+    def _trace_instant(self, name, req=None, **attrs):
+        if self.tracer is None:
+            return
+        self.tracer.add_instant(
+            name, cat="request",
+            trace_id=None if req is None else req.trace_id,
+            args={"req_id": str(req.req_id), **attrs} if req is not None
+            else (attrs or None))
+
+    def _flush_step_phases(self, wall):
+        """Fold the finished step's phase clock into the host-gap
+        histogram and the device-fraction gauge."""
+        totals = self._phases.reset()
+        if not totals:
+            return
+        for phase, dt in totals.items():
+            self._m_host_gap.labels(phase=phase).observe(dt)
+        dev = totals.get("device_wait", 0.0)
+        self._m_device_fraction.set(
+            min(dev / wall, 1.0) if wall > 0 else 0.0)
+
+    def dump_flight_recorder(self):
+        """The bounded ring of recent request-lifecycle events
+        (oldest first, JSON-able) — the postmortem `drain()`'s leak
+        audit attaches automatically."""
+        return self.flight.dump()
+
+    def _audit_error(self, msg):
+        """A drain-audit failure with the flight-recorder history
+        attached: the bare assertion becomes a postmortem."""
+        return RuntimeError(msg + "\n" + self.flight.format(limit=64))
+
+    def export_trace(self, path, include_profiler=True):
+        """Write this engine's span ring as one Chrome trace-event /
+        Perfetto JSON timeline, merged (same monotonic clock) with any
+        spans currently buffered in the profiler's host-event stream.
+        Returns the event count written."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "tracing is off — build the engine with tracing=True "
+                "(or PADDLE_SERVE_TRACING=1) to record spans")
+        groups = [("engine", self.tracer.snapshot())]
+        if include_profiler:
+            ev = profiler_host_events()
+            if ev:
+                groups.append(("profiler", ev))
+        return export_timeline(path, groups)
 
     # -- compiled steps ----------------------------------------------------
     def _default_buckets(self):
@@ -1877,7 +2023,7 @@ class GenerationEngine:
     def add_request(self, prompt, max_new_tokens, eos_token_id=None,
                     req_id=None, priority="standard",
                     prefill_only=False, adapter_id=0,
-                    sampling_params=None):
+                    sampling_params=None, trace_id=None):
         """Queue a request; admitted into a free slot between decode
         iterations (may be called while `run`/`step` is mid-stream).
         `priority` is one of PRIORITY_CLASSES — higher classes admit
@@ -1915,10 +2061,18 @@ class GenerationEngine:
         prompt, req_id = self._intake_guard(prompt, max_new_tokens,
                                             priority, req_id)
         eos = self.eos_token_id if eos_token_id is None else eos_token_id
+        if self.tracing and trace_id is None:
+            trace_id = new_trace_id()
         req = Request(req_id, prompt, int(max_new_tokens), eos,
                       arrived_at=time.perf_counter(), priority=priority,
                       prefill_only=bool(prefill_only),
-                      adapter_id=adapter_id, sampling=sampling_params)
+                      adapter_id=adapter_id, sampling=sampling_params,
+                      trace_id=trace_id)
+        self.flight.record("queued", req_id, priority=priority,
+                           plen=int(prompt.size),
+                           adapter=int(adapter_id))
+        self._trace_instant("request.queued", req,
+                            priority=priority, plen=int(prompt.size))
         if self.max_queue is not None \
                 and self.num_pending >= self.max_queue:
             victim = self._shed_victim(priority)
@@ -1944,6 +2098,8 @@ class GenerationEngine:
         self._results[req.req_id] = None
         self._m_shed.labels(priority=req.priority).inc()
         self._m_queue.set(self.num_pending)
+        self.flight.record("shed", req.req_id, priority=req.priority)
+        self._trace_instant("request.shed", req, priority=req.priority)
 
     # -- scheduler ---------------------------------------------------------
     def _bucket_for(self, plen):
@@ -2033,6 +2189,10 @@ class GenerationEngine:
         self.cache.free(slot.blocks)
         self._release_adapter(slot)
         self._m_finished.labels(reason=reason).inc()
+        self.flight.record("finish", req.req_id, reason=reason,
+                           tokens=len(slot.generated))
+        self._trace_instant("request.finish", req, reason=reason,
+                            tokens=len(slot.generated))
 
     def _first_token(self, slot, first, t_step):
         """Seat a request's FIRST generated token (from the final
@@ -2045,6 +2205,8 @@ class GenerationEngine:
         slot.generated.append(first)
         slot.last_token_at = now
         self._note_tokens(req)
+        self.flight.record("first_token", req.req_id)
+        self._trace_instant("request.first_token", req)
         if req.arrived_at is not None:
             self._obs_ttft(req, now - req.arrived_at)
         if self.enable_prefix_cache:
@@ -2088,6 +2250,10 @@ class GenerationEngine:
         # decode replica acquires from its OWN pool at adoption
         self._release_adapter(slot)
         self._m_finished.labels(reason="handoff").inc()
+        self.flight.record("handoff_parked", req.req_id,
+                           blocks=len(slot.blocks))
+        self._trace_instant("request.handoff", req,
+                            blocks=len(slot.blocks))
 
     # -- admission: chunked (default) --------------------------------------
     def _admit_chunked(self):
@@ -2098,34 +2264,42 @@ class GenerationEngine:
         a full-prefix hit enters decode directly (feeding the last
         prompt token; copy-on-write keeps its write private)."""
         admitted = 0
-        while None in self._slots:
-            req = self._pop_request()
-            if req is None:
-                break
-            page = self._acquire_adapter(req)
-            if page is None:
-                # adapter-pool pressure: every page is referenced by a
-                # live lane. Requeue at the FRONT (strict order kept)
-                # and retry when a lane vacates — the KV stall/retry
-                # contract, page-sized.
-                self._queues[req.priority].appendleft(req)
-                break
-            blocks, hit = [], 0
-            if self.enable_prefix_cache:
-                blocks, hit = self.cache.match_prefix(
-                    req.prompt, adapter_id=req.adapter_id)
-                if hit:
-                    self.prefix_hit_tokens += hit
-                    self._m_hit_tokens.inc(hit)
-            slot = _Slot(req=req, blocks=list(blocks), prefill_pos=hit,
-                         hit_tokens=hit, admit_seq=self._admit_counter,
-                         adapter_page=page,
-                         **self._slot_sampling_fields(req))
-            self._admit_counter += 1
-            self._slots[self._slots.index(None)] = slot
-            self._m_admissions.inc()
-            self._update_pool_gauges()
-            admitted += 1
+        with self._phase("schedule"):
+            while None in self._slots:
+                req = self._pop_request()
+                if req is None:
+                    break
+                page = self._acquire_adapter(req)
+                if page is None:
+                    # adapter-pool pressure: every page is referenced
+                    # by a live lane. Requeue at the FRONT (strict
+                    # order kept) and retry when a lane vacates — the
+                    # KV stall/retry contract, page-sized.
+                    self._queues[req.priority].appendleft(req)
+                    break
+                blocks, hit = [], 0
+                if self.enable_prefix_cache:
+                    with self._phase("prefix_lookup"):
+                        blocks, hit = self.cache.match_prefix(
+                            req.prompt, adapter_id=req.adapter_id)
+                    if hit:
+                        self.prefix_hit_tokens += hit
+                        self._m_hit_tokens.inc(hit)
+                slot = _Slot(req=req, blocks=list(blocks),
+                             prefill_pos=hit,
+                             hit_tokens=hit,
+                             admit_seq=self._admit_counter,
+                             adapter_page=page,
+                             **self._slot_sampling_fields(req))
+                self._admit_counter += 1
+                self._slots[self._slots.index(None)] = slot
+                self._m_admissions.inc()
+                self.flight.record("admitted", req.req_id,
+                                   hit_tokens=hit)
+                self._trace_instant("request.admitted", req,
+                                    hit_tokens=hit)
+                self._update_pool_gauges()
+                admitted += 1
         self._m_queue.set(self.num_pending)
         return admitted
 
@@ -2136,11 +2310,20 @@ class GenerationEngine:
         and retries — admission's analog of a KV block stall)."""
         if self.adapter_pool is None or not req.adapter_id:
             return 0
-        page = self.adapter_pool.acquire(req.adapter_id)
+        with self._phase("adapter_swap"):
+            swapins = self.adapter_pool.swapins
+            page = self.adapter_pool.acquire(req.adapter_id)
         if page is None:
             self._m_stalls.labels(path="adapter",
                                   shard=self._shard).inc()
+            self.flight.record("stall", req.req_id, path="adapter")
             return None
+        if self.adapter_pool.swapins > swapins:
+            # cold page: the acquire paid a host->device swap-in
+            self.flight.record("adapter_swap_in", req.req_id,
+                               adapter=int(req.adapter_id), page=page)
+            self._trace_instant("adapter.swap_in", req,
+                                adapter=int(req.adapter_id), page=page)
         self._update_adapter_gauges()
         return page
 
@@ -2152,47 +2335,61 @@ class GenerationEngine:
         chunk program. The final chunk yields the first generated
         token. A lane that cannot get blocks stalls and the next
         candidate gets the chunk."""
-        cands = [s for s in self._slots
-                 if s is not None and s.prefilling]
-        cands.sort(key=lambda s: (
-            PRIORITY_CLASSES.index(s.req.priority), s.admit_seq))
+        with self._phase("schedule"):
+            cands = [s for s in self._slots
+                     if s is not None and s.prefilling]
+            cands.sort(key=lambda s: (
+                PRIORITY_CLASSES.index(s.req.priority), s.admit_seq))
         C = self.prefill_chunk
         for slot in cands:
             req = slot.req
             plen = int(req.prompt.size)
             start = slot.prefill_pos
             end = min(start + C, plen)
-            need = math.ceil(end / self.block_size) - len(slot.blocks)
-            if need > 0:
-                got = self.cache.allocate(need)
-                if got is None:
-                    self._m_stalls.labels(
-                        path="prefill", shard=self._shard).inc()
-                    continue           # pool pressure: next candidate
-                slot.blocks.extend(got)
-                self._update_pool_gauges()
-            tokens = np.zeros((1, C), np.int32)
-            tokens[0, :end - start] = req.prompt[start:end]
-            row = np.zeros(self.max_blocks, np.int32)
-            row[:len(slot.blocks)] = slot.blocks
-            args = [jnp.asarray(tokens), jnp.int32(start),
-                    jnp.int32(plen), jnp.asarray(row)]
-            if self.sampling:
-                # the chunk serves ONE slot: its sampling rows, [1]
-                args.extend(self._sampling_host_args_one(slot))
-            if self.adapter_pool is not None:
-                # the chunk serves ONE slot: its adapter page, [1]-row
-                args.append(jnp.asarray(
-                    np.asarray([slot.adapter_page], np.int32)))
-            with RecordEvent("engine.prefill"):
-                t0 = time.perf_counter()
-                nxt = self._dispatch_step(self._prefill, *args)
-                self._m_prefill_chunks.inc()
-                slot.prefill_pos = end
-                if end < plen:         # mid-prompt: no sync needed
-                    return 1
-                first = int(nxt)       # sync: first token is out
-            self._first_token(slot, first, t0)
+            with self._phase("schedule"):
+                need = math.ceil(end / self.block_size) \
+                    - len(slot.blocks)
+                if need > 0:
+                    got = self.cache.allocate(need)
+                    if got is None:
+                        self._m_stalls.labels(
+                            path="prefill", shard=self._shard).inc()
+                        self.flight.record("stall", req.req_id,
+                                           path="prefill")
+                        continue       # pool pressure: next candidate
+                    slot.blocks.extend(got)
+                    self._update_pool_gauges()
+            t_span = now_us()
+            with self._phase("dispatch"):
+                tokens = np.zeros((1, C), np.int32)
+                tokens[0, :end - start] = req.prompt[start:end]
+                row = np.zeros(self.max_blocks, np.int32)
+                row[:len(slot.blocks)] = slot.blocks
+                args = [jnp.asarray(tokens), jnp.int32(start),
+                        jnp.int32(plen), jnp.asarray(row)]
+                if self.sampling:
+                    # the chunk serves ONE slot: its sampling rows, [1]
+                    args.extend(self._sampling_host_args_one(slot))
+                if self.adapter_pool is not None:
+                    # the chunk serves ONE slot: its adapter page,
+                    # [1]-row
+                    args.append(jnp.asarray(
+                        np.asarray([slot.adapter_page], np.int32)))
+                with RecordEvent("engine.prefill"):
+                    t0 = time.perf_counter()
+                    nxt = self._dispatch_step(self._prefill, *args)
+                    self._m_prefill_chunks.inc()
+                    slot.prefill_pos = end
+                    if end < plen:     # mid-prompt: no sync needed
+                        self._trace_span("prefill.chunk", t_span,
+                                         req=req, start=start, end=end)
+                        return 1
+                    with self._phase("device_wait"):
+                        first = int(nxt)   # sync: first token is out
+            self._trace_span("prefill.chunk", t_span, req=req,
+                             start=start, end=end, final=True)
+            with self._phase("finish"):
+                self._first_token(slot, first, t0)
             return 1
         return 0
 
@@ -2207,10 +2404,12 @@ class GenerationEngine:
             if req is None:
                 break
             plen = int(req.prompt.size)
-            need = math.ceil(plen / self.block_size)
-            blocks = self.cache.allocate(need)
+            with self._phase("schedule"):
+                need = math.ceil(plen / self.block_size)
+                blocks = self.cache.allocate(need)
             if blocks is None:
                 self._m_stalls.labels(path="admit", shard=self._shard).inc()
+                self.flight.record("stall", req.req_id, path="admit")
                 break                      # pool pressure: retry later
             self._update_pool_gauges()     # high-water sees the peak
             # adapter page AFTER the blocks: a block stall must not
@@ -2223,10 +2422,6 @@ class GenerationEngine:
                 break                  # adapter pressure: retry later
             self._pop_request()
             bucket = self._bucket_for(plen)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :plen] = req.prompt
-            row = np.zeros(self.max_blocks, np.int32)
-            row[:need] = blocks
             slot = _Slot(req=req, blocks=blocks, prefill_pos=plen,
                          admit_seq=self._admit_counter,
                          adapter_page=page,
@@ -2234,19 +2429,31 @@ class GenerationEngine:
             self._admit_counter += 1
             self._slots[self._slots.index(None)] = slot
             self._m_admissions.inc()
+            self.flight.record("admitted", req.req_id, bucket=bucket)
+            self._trace_instant("request.admitted", req, bucket=bucket)
             admitted += 1
-            args = [jnp.asarray(tokens), jnp.int32(plen),
-                    jnp.asarray(row)]
-            if self.sampling:
-                args.extend(self._sampling_host_args_one(slot))
-            if self.adapter_pool is not None:
-                args.append(jnp.asarray(
-                    np.asarray([slot.adapter_page], np.int32)))
-            with RecordEvent("engine.prefill"):
-                t0 = time.perf_counter()
-                first = self._dispatch_step(self._prefill, *args)
-                first = int(first)         # sync: first token is out
-            self._first_token(slot, first, t0)
+            t_span = now_us()
+            with self._phase("dispatch"):
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :plen] = req.prompt
+                row = np.zeros(self.max_blocks, np.int32)
+                row[:need] = blocks
+                args = [jnp.asarray(tokens), jnp.int32(plen),
+                        jnp.asarray(row)]
+                if self.sampling:
+                    args.extend(self._sampling_host_args_one(slot))
+                if self.adapter_pool is not None:
+                    args.append(jnp.asarray(
+                        np.asarray([slot.adapter_page], np.int32)))
+                with RecordEvent("engine.prefill"):
+                    t0 = time.perf_counter()
+                    first = self._dispatch_step(self._prefill, *args)
+                    with self._phase("device_wait"):
+                        first = int(first)   # sync: first token is out
+            self._trace_span("prefill.bucketed", t_span, req=req,
+                             bucket=bucket)
+            with self._phase("finish"):
+                self._first_token(slot, first, t0)
         self._m_queue.set(self.num_pending)
         return admitted
 
@@ -2262,9 +2469,11 @@ class GenerationEngine:
         if got is None:
             if count_stall:
                 self._m_stalls.labels(path="decode", shard=self._shard).inc()
+                self.flight.record("stall", slot.req.req_id,
+                                   path="decode")
             return False
         src, dst = slot.blocks[bi], got[0]
-        with RecordEvent("engine.cow"):
+        with self._phase("cow"), RecordEvent("engine.cow"):
             if self.cache.scales is not None:
                 # quantized pools: the block's per-layer grid rows
                 # ride the copy — a COW'd block must dequantize on
@@ -2293,89 +2502,107 @@ class GenerationEngine:
         if self.spec_decode_k:
             return self._spec_decode_step()
         runnable = []
-        for i, slot in enumerate(self._slots):
-            if slot is None or slot.prefilling:
-                continue
-            bi = slot.feed_pos // self.block_size
-            if bi >= len(slot.blocks):
-                # on-demand growth: the feed position opens a new block
-                got = self.cache.allocate(1)
-                if got is None:
-                    self._m_stalls.labels(
-                        path="decode", shard=self._shard).inc()
-                    continue           # stalled this iteration
-                slot.blocks.extend(got)
-                self._update_pool_gauges()
-            elif self.cache.needs_cow(slot.blocks[bi]):
-                # the write position sits in a block other owners (or
-                # the prefix cache) still read — promote to a private
-                # copy so the shared KV stays byte-identical for them
-                if not self._cow_promote(slot, bi):
-                    continue           # pool pressure: stalled
-            runnable.append(i)
+        with self._phase("schedule"):
+            for i, slot in enumerate(self._slots):
+                if slot is None or slot.prefilling:
+                    continue
+                bi = slot.feed_pos // self.block_size
+                if bi >= len(slot.blocks):
+                    # on-demand growth: the feed position opens a new
+                    # block
+                    got = self.cache.allocate(1)
+                    if got is None:
+                        self._m_stalls.labels(
+                            path="decode", shard=self._shard).inc()
+                        self.flight.record("stall", slot.req.req_id,
+                                           path="decode")
+                        continue       # stalled this iteration
+                    slot.blocks.extend(got)
+                    self._update_pool_gauges()
+                elif self.cache.needs_cow(slot.blocks[bi]):
+                    # the write position sits in a block other owners
+                    # (or the prefix cache) still read — promote to a
+                    # private copy so the shared KV stays
+                    # byte-identical for them
+                    if not self._cow_promote(slot, bi):
+                        continue       # pool pressure: stalled
+                runnable.append(i)
         if not runnable:
             return 0
-        tokens = np.zeros((self.num_slots, 1), np.int32)
-        positions = np.zeros(self.num_slots, np.int32)
-        tables = np.zeros((self.num_slots, self.max_blocks),
-                          np.int32)
-        arows = np.zeros(self.num_slots, np.int32)
-        for i in runnable:
-            slot = self._slots[i]
-            tokens[i, 0] = slot.feed_token
-            positions[i] = slot.feed_pos
-            tables[i, :len(slot.blocks)] = slot.blocks
-            arows[i] = slot.adapter_page
-        args = [jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(tables)]
-        if self.sampling:
-            # per-slot sampling rows (idle/greedy lanes ride temp 0 —
-            # the argmax select, like the null block)
-            args.extend(self._sampling_host_args())
-        if self.adapter_pool is not None:
-            # per-slot adapter page row (idle/stalled lanes ride the
-            # null page 0 — exact-zero delta, like the null block)
-            args.append(jnp.asarray(arows))
-        with RecordEvent("engine.decode"):
-            t_dec = time.perf_counter()
-            nxt = self._dispatch_step(self._decode, *args)
-            nxt = np.asarray(nxt)      # sync: tokens are out
-            self._m_decode_seconds.observe(
-                time.perf_counter() - t_dec)
+        t_span = now_us()
+        with self._phase("dispatch"):
+            tokens = np.zeros((self.num_slots, 1), np.int32)
+            positions = np.zeros(self.num_slots, np.int32)
+            tables = np.zeros((self.num_slots, self.max_blocks),
+                              np.int32)
+            arows = np.zeros(self.num_slots, np.int32)
+            for i in runnable:
+                slot = self._slots[i]
+                tokens[i, 0] = slot.feed_token
+                positions[i] = slot.feed_pos
+                tables[i, :len(slot.blocks)] = slot.blocks
+                arows[i] = slot.adapter_page
+            args = [jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(tables)]
+            if self.sampling:
+                # per-slot sampling rows (idle/greedy lanes ride temp
+                # 0 — the argmax select, like the null block)
+                args.extend(self._sampling_host_args())
+            if self.adapter_pool is not None:
+                # per-slot adapter page row (idle/stalled lanes ride
+                # the null page 0 — exact-zero delta, like the null
+                # block)
+                args.append(jnp.asarray(arows))
+            with RecordEvent("engine.decode"):
+                t_dec = time.perf_counter()
+                nxt = self._dispatch_step(self._decode, *args)
+                with self._phase("device_wait"):
+                    nxt = np.asarray(nxt)  # sync: tokens are out
+                self._m_decode_seconds.observe(
+                    time.perf_counter() - t_dec)
+        self._trace_span("decode.step", t_span, cat="engine",
+                         lanes=len(runnable))
         now = time.perf_counter()
-        for i in runnable:
-            slot = self._slots[i]
-            tok = int(nxt[i])
-            is_first = not slot.generated    # full-prefix-hit lane
-            slot.generated.append(tok)
-            req = slot.req
-            self._note_tokens(req)
-            if is_first:
-                # this decode produced the request's FIRST token (its
-                # whole prompt came from the prefix cache)
-                if req.arrived_at is not None:
-                    self._obs_ttft(req, now - req.arrived_at)
-            elif slot.last_token_at is not None:
-                # inter-token latency per SLOT, not this iteration's
-                # wall time: a lane that sat out N stalled iterations
-                # reports the (N+1)-iteration gap its user experienced
-                self._obs_tpot(req, now - slot.last_token_at)
-            slot.last_token_at = now
-            done_eos = req.eos_token_id is not None \
-                and tok == req.eos_token_id
-            if done_eos or len(slot.generated) >= req.max_new_tokens:
+        with self._phase("finish"):
+            for i in runnable:
+                slot = self._slots[i]
+                tok = int(nxt[i])
+                is_first = not slot.generated   # full-prefix-hit lane
+                slot.generated.append(tok)
+                req = slot.req
+                self._note_tokens(req)
                 if is_first:
-                    # single-token request: its only token still lands
-                    # in the TPOT histogram (producing-step latency)
-                    self._obs_tpot(req, now - t_dec)
-                if req.prefill_only:
-                    # full-prefix-hit prefill-only lane: its one decode
-                    # step produced the first token — park the blocks
-                    # for the disaggregated handoff, don't free them
-                    self._handoff_finish(slot)
-                else:
-                    self._finish(slot, "eos" if done_eos else "length")
-                self._slots[i] = None
+                    # this decode produced the request's FIRST token
+                    # (its whole prompt came from the prefix cache)
+                    if req.arrived_at is not None:
+                        self._obs_ttft(req, now - req.arrived_at)
+                    self.flight.record("first_token", req.req_id)
+                    self._trace_instant("request.first_token", req)
+                elif slot.last_token_at is not None:
+                    # inter-token latency per SLOT, not this
+                    # iteration's wall time: a lane that sat out N
+                    # stalled iterations reports the (N+1)-iteration
+                    # gap its user experienced
+                    self._obs_tpot(req, now - slot.last_token_at)
+                slot.last_token_at = now
+                done_eos = req.eos_token_id is not None \
+                    and tok == req.eos_token_id
+                if done_eos or len(slot.generated) >= req.max_new_tokens:
+                    if is_first:
+                        # single-token request: its only token still
+                        # lands in the TPOT histogram (producing-step
+                        # latency)
+                        self._obs_tpot(req, now - t_dec)
+                    if req.prefill_only:
+                        # full-prefix-hit prefill-only lane: its one
+                        # decode step produced the first token — park
+                        # the blocks for the disaggregated handoff,
+                        # don't free them
+                        self._handoff_finish(slot)
+                    else:
+                        self._finish(slot,
+                                     "eos" if done_eos else "length")
+                    self._slots[i] = None
         return len(runnable)
 
     def _spec_decode_step(self):
@@ -2395,193 +2622,223 @@ class GenerationEngine:
         bs = self.block_size
         vocab = self.model.config.vocab_size
         runnable, drafts = [], {}
-        for i, slot in enumerate(self._slots):
-            if slot is None or slot.prefilling:
-                continue
-            req = slot.req
-            # window budget: emitted tokens cap at the request's
-            # remaining allowance, and the last write position must
-            # stay inside the model's length
-            budget = min(K,
-                         req.max_new_tokens - len(slot.generated) - 1,
-                         self.max_model_len - 1 - slot.feed_pos)
-            draft = []
-            if budget > 0:
-                for t in self.drafter.propose(req.prompt,
-                                              slot.generated, budget):
-                    t = int(t)
-                    if not 0 <= t < vocab or len(draft) >= budget:
-                        break          # junk proposal: verify nothing
-                    draft.append(t)
-            # grow the table to cover the window's last write; under
-            # pool pressure shed the draft (plain one-token window)
-            # before stalling the lane outright
-            stalled = False
-            while True:
-                need = (slot.feed_pos + len(draft)) // bs + 1 \
-                    - len(slot.blocks)
-                if need <= 0:
-                    break
-                got = self.cache.allocate(need)
-                if got is not None:
-                    slot.blocks.extend(got)
-                    self._update_pool_gauges()
-                    break
-                if not draft:
-                    self._m_stalls.labels(
-                        path="decode", shard=self._shard).inc()
-                    stalled = True
-                    break
-                draft = []             # degrade: draftless step
-                self._m_stalls.labels(
-                    path="spec_degrade", shard=self._shard).inc()
-            if stalled:
-                continue
-            # copy-on-write over EVERY block the window writes into —
-            # a speculative write must never land in a block other
-            # owners (or the prefix cache) still read
-            def cow_window(k_len, count_stall):
-                for bi in range(slot.feed_pos // bs,
-                                (slot.feed_pos + k_len) // bs + 1):
-                    if self.cache.needs_cow(slot.blocks[bi]) \
-                            and not self._cow_promote(
-                                slot, bi, count_stall=count_stall):
-                        return False
-                return True
-
-            if not cow_window(len(draft), count_stall=False):
-                # pool pressure mid-window: shed the draft AND the
-                # surplus tail blocks past the feed block (always
-                # private — they only ever held rejected rows), so
-                # the pool gets them back, then retry the plain
-                # one-token window. Without this a lane could sit on
-                # window blocks while stalling on the COW copy —
-                # deadlocking pools where the K=0 engine progresses.
-                # The degrade is its own stall flavor: the lane still
-                # RUNS, so it must not read as a skipped iteration.
-                feed_bi = slot.feed_pos // bs
-                surplus = slot.blocks[feed_bi + 1:]
-                if surplus:
-                    del slot.blocks[feed_bi + 1:]
-                    self.cache.free(surplus)
-                    self._update_pool_gauges()
-                if draft:
-                    draft = []
+        with self._phase("schedule"):
+            for i, slot in enumerate(self._slots):
+                if slot is None or slot.prefilling:
+                    continue
+                req = slot.req
+                # window budget: emitted tokens cap at the request's
+                # remaining allowance, and the last write position
+                # must stay inside the model's length
+                budget = min(
+                    K,
+                    req.max_new_tokens - len(slot.generated) - 1,
+                    self.max_model_len - 1 - slot.feed_pos)
+                draft = []
+                if budget > 0:
+                    with self._phase("draft_propose"):
+                        for t in self.drafter.propose(
+                                req.prompt, slot.generated, budget):
+                            t = int(t)
+                            if not 0 <= t < vocab \
+                                    or len(draft) >= budget:
+                                break  # junk proposal: verify nothing
+                            draft.append(t)
+                # grow the table to cover the window's last write;
+                # under pool pressure shed the draft (plain one-token
+                # window) before stalling the lane outright
+                stalled = False
+                while True:
+                    need = (slot.feed_pos + len(draft)) // bs + 1 \
+                        - len(slot.blocks)
+                    if need <= 0:
+                        break
+                    got = self.cache.allocate(need)
+                    if got is not None:
+                        slot.blocks.extend(got)
+                        self._update_pool_gauges()
+                        break
+                    if not draft:
+                        self._m_stalls.labels(
+                            path="decode", shard=self._shard).inc()
+                        self.flight.record("stall", req.req_id,
+                                           path="decode")
+                        stalled = True
+                        break
+                    draft = []         # degrade: draftless step
                     self._m_stalls.labels(
                         path="spec_degrade", shard=self._shard).inc()
-                if not cow_window(0, count_stall=True):
-                    continue           # truly stalled this iteration
-            drafts[i] = draft
-            runnable.append(i)
+                    self.flight.record("stall", req.req_id,
+                                       path="spec_degrade")
+                if stalled:
+                    continue
+                # copy-on-write over EVERY block the window writes
+                # into — a speculative write must never land in a
+                # block other owners (or the prefix cache) still read
+                def cow_window(k_len, count_stall):
+                    for bi in range(slot.feed_pos // bs,
+                                    (slot.feed_pos + k_len) // bs + 1):
+                        if self.cache.needs_cow(slot.blocks[bi]) \
+                                and not self._cow_promote(
+                                    slot, bi, count_stall=count_stall):
+                            return False
+                    return True
+
+                if not cow_window(len(draft), count_stall=False):
+                    # pool pressure mid-window: shed the draft AND the
+                    # surplus tail blocks past the feed block (always
+                    # private — they only ever held rejected rows), so
+                    # the pool gets them back, then retry the plain
+                    # one-token window. Without this a lane could sit
+                    # on window blocks while stalling on the COW copy
+                    # — deadlocking pools where the K=0 engine
+                    # progresses. The degrade is its own stall flavor:
+                    # the lane still RUNS, so it must not read as a
+                    # skipped iteration.
+                    feed_bi = slot.feed_pos // bs
+                    surplus = slot.blocks[feed_bi + 1:]
+                    if surplus:
+                        del slot.blocks[feed_bi + 1:]
+                        self.cache.free(surplus)
+                        self._update_pool_gauges()
+                    if draft:
+                        draft = []
+                        self._m_stalls.labels(
+                            path="spec_degrade", shard=self._shard).inc()
+                        self.flight.record("stall", req.req_id,
+                                           path="spec_degrade")
+                    if not cow_window(0, count_stall=True):
+                        continue       # truly stalled this iteration
+                drafts[i] = draft
+                runnable.append(i)
         if not runnable:
             return 0
-        tokens = np.zeros((self.num_slots, W), np.int32)
-        positions = np.zeros(self.num_slots, np.int32)
-        dlens = np.zeros(self.num_slots, np.int32)
-        tables = np.zeros((self.num_slots, self.max_blocks), np.int32)
-        arows = np.zeros(self.num_slots, np.int32)
-        for i in runnable:
-            slot = self._slots[i]
-            d = drafts[i]
-            tokens[i, 0] = slot.feed_token
-            if d:
-                tokens[i, 1:1 + len(d)] = d
-            positions[i] = slot.feed_pos
-            dlens[i] = len(d)
-            tables[i, :len(slot.blocks)] = slot.blocks
-            arows[i] = slot.adapter_page
-        args = [jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(dlens), jnp.asarray(tables)]
-        if self.sampling:
-            args.extend(self._sampling_host_args())
-        if self.adapter_pool is not None:
-            args.append(jnp.asarray(arows))
-        with RecordEvent("engine.decode"):
-            t_dec = time.perf_counter()
-            out_dev = self._dispatch_step(self._decode, *args,
-                                          n_out=self._decode_n_out)
+        t_span = now_us()
+        with self._phase("dispatch"):
+            tokens = np.zeros((self.num_slots, W), np.int32)
+            positions = np.zeros(self.num_slots, np.int32)
+            dlens = np.zeros(self.num_slots, np.int32)
+            tables = np.zeros((self.num_slots, self.max_blocks),
+                              np.int32)
+            arows = np.zeros(self.num_slots, np.int32)
+            for i in runnable:
+                slot = self._slots[i]
+                d = drafts[i]
+                tokens[i, 0] = slot.feed_token
+                if d:
+                    tokens[i, 1:1 + len(d)] = d
+                positions[i] = slot.feed_pos
+                dlens[i] = len(d)
+                tables[i, :len(slot.blocks)] = slot.blocks
+                arows[i] = slot.adapter_page
+            args = [jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(dlens), jnp.asarray(tables)]
             if self.sampling:
-                # sync: per-row stop-choices + accept flags
-                choices = np.asarray(out_dev[0])
-                accepts = np.asarray(out_dev[1])
-                nxt = None
-            else:
-                nxt = np.asarray(out_dev)  # sync: [slots, K+1] argmaxes
-            self._m_decode_seconds.observe(
-                time.perf_counter() - t_dec)
+                args.extend(self._sampling_host_args())
+            if self.adapter_pool is not None:
+                args.append(jnp.asarray(arows))
+            with RecordEvent("engine.decode"):
+                t_dec = time.perf_counter()
+                out_dev = self._dispatch_step(self._decode, *args,
+                                              n_out=self._decode_n_out)
+                with self._phase("device_wait"):
+                    if self.sampling:
+                        # sync: per-row stop-choices + accept flags
+                        choices = np.asarray(out_dev[0])
+                        accepts = np.asarray(out_dev[1])
+                        nxt = None
+                    else:
+                        # sync: [slots, K+1] argmaxes
+                        nxt = np.asarray(out_dev)
+                self._m_decode_seconds.observe(
+                    time.perf_counter() - t_dec)
+        self._trace_span("decode.verify", t_span, cat="engine",
+                         lanes=len(runnable), k=K)
         now = time.perf_counter()
-        for i in runnable:
-            slot = self._slots[i]
-            req = slot.req
-            d = drafts[i]
-            if self.sampling:
-                # rejection-sampling acceptance (computed on device):
-                # accept the longest draft prefix whose coins passed,
-                # then the stop row's choice — the residual resample
-                # on a rejection, the bonus draw on a full accept.
-                # Greedy lanes' flags are exact argmax equality and
-                # their choices the argmax, so this walk reproduces
-                # the exact-acceptance stream bit-for-bit.
-                n = 0
-                while n < len(d) and accepts[i, n]:
-                    n += 1
-                acc = [int(t) for t in d[:n]] + [int(choices[i, n])]
-            else:
-                out = nxt[i]
-                # exact greedy acceptance: the target's own next
-                # token, then every draft token that EQUALS the
-                # target's argmax at its position (each match
-                # validates the next column)
-                acc = [int(out[0])]
-                for j, dj in enumerate(d):
-                    if dj != int(out[j]):
-                        break
-                    acc.append(int(out[j + 1]))
-            self._m_spec_ok.inc(len(acc) - 1)
-            self._m_spec_rej.inc(len(d) - (len(acc) - 1))
-            # EOS / length truncation: emit stops AT the first stop
-            # token, exactly like the one-token path would have
-            emit = []
-            for t in acc:
-                emit.append(t)
-                if (req.eos_token_id is not None
-                        and t == req.eos_token_id) \
-                        or len(slot.generated) + len(emit) \
-                        >= req.max_new_tokens:
-                    break
-            m_tok = len(emit)
-            is_first = not slot.generated      # full-prefix-hit lane
-            slot.generated.extend(emit)
-            self._note_tokens(req, m_tok)
-            self._m_spec_accepted.observe(m_tok)
-            proposed = self._m_spec_ok.value + self._m_spec_rej.value
-            if proposed:
-                self._m_spec_hit_rate.set(
-                    self._m_spec_ok.value / proposed)
-            if is_first and req.arrived_at is not None:
-                self._obs_ttft(req, now - req.arrived_at)
-            # multi-token latency accounting: every accepted token is
-            # recorded against its producing step — the lane's step
-            # gap amortized per token, so TPOT sums still integrate
-            # to wall time and m_tok=1 degenerates to the plain path
-            gap = now - (t_dec if is_first or slot.last_token_at is None
-                         else slot.last_token_at)
-            n_tpot = m_tok - 1 if is_first else m_tok
-            for _ in range(n_tpot):
-                self._obs_tpot(req, gap / m_tok)
-            slot.last_token_at = now
-            done_eos = req.eos_token_id is not None \
-                and emit[-1] == req.eos_token_id
-            if done_eos or len(slot.generated) >= req.max_new_tokens:
-                if is_first and n_tpot == 0:
-                    # single-token instant finisher: keep it visible
-                    # (the PR-6 TPOT contract)
-                    self._obs_tpot(req, now - t_dec)
-                if req.prefill_only:
-                    self._handoff_finish(slot)
+        with self._phase("finish"):
+            for i in runnable:
+                slot = self._slots[i]
+                req = slot.req
+                d = drafts[i]
+                if self.sampling:
+                    # rejection-sampling acceptance (computed on
+                    # device): accept the longest draft prefix whose
+                    # coins passed, then the stop row's choice — the
+                    # residual resample on a rejection, the bonus draw
+                    # on a full accept. Greedy lanes' flags are exact
+                    # argmax equality and their choices the argmax, so
+                    # this walk reproduces the exact-acceptance stream
+                    # bit-for-bit.
+                    with self._phase("sample_walk"):
+                        n = 0
+                        while n < len(d) and accepts[i, n]:
+                            n += 1
+                        acc = [int(t) for t in d[:n]] \
+                            + [int(choices[i, n])]
                 else:
-                    self._finish(slot, "eos" if done_eos else "length")
-                self._slots[i] = None
+                    # exact greedy acceptance: the target's own next
+                    # token, then every draft token that EQUALS the
+                    # target's argmax at its position (each match
+                    # validates the next column)
+                    with self._phase("accept_walk"):
+                        out = nxt[i]
+                        acc = [int(out[0])]
+                        for j, dj in enumerate(d):
+                            if dj != int(out[j]):
+                                break
+                            acc.append(int(out[j + 1]))
+                self._m_spec_ok.inc(len(acc) - 1)
+                self._m_spec_rej.inc(len(d) - (len(acc) - 1))
+                # EOS / length truncation: emit stops AT the first
+                # stop token, exactly like the one-token path would
+                emit = []
+                for t in acc:
+                    emit.append(t)
+                    if (req.eos_token_id is not None
+                            and t == req.eos_token_id) \
+                            or len(slot.generated) + len(emit) \
+                            >= req.max_new_tokens:
+                        break
+                m_tok = len(emit)
+                is_first = not slot.generated  # full-prefix-hit lane
+                slot.generated.extend(emit)
+                self._note_tokens(req, m_tok)
+                self._m_spec_accepted.observe(m_tok)
+                proposed = self._m_spec_ok.value \
+                    + self._m_spec_rej.value
+                if proposed:
+                    self._m_spec_hit_rate.set(
+                        self._m_spec_ok.value / proposed)
+                if is_first:
+                    if req.arrived_at is not None:
+                        self._obs_ttft(req, now - req.arrived_at)
+                    self.flight.record("first_token", req.req_id)
+                    self._trace_instant("request.first_token", req)
+                # multi-token latency accounting: every accepted token
+                # is recorded against its producing step — the lane's
+                # step gap amortized per token, so TPOT sums still
+                # integrate to wall time and m_tok=1 degenerates to
+                # the plain path
+                gap = now - (t_dec
+                             if is_first or slot.last_token_at is None
+                             else slot.last_token_at)
+                n_tpot = m_tok - 1 if is_first else m_tok
+                for _ in range(n_tpot):
+                    self._obs_tpot(req, gap / m_tok)
+                slot.last_token_at = now
+                done_eos = req.eos_token_id is not None \
+                    and emit[-1] == req.eos_token_id
+                if done_eos or len(slot.generated) >= req.max_new_tokens:
+                    if is_first and n_tpot == 0:
+                        # single-token instant finisher: keep it
+                        # visible (the PR-6 TPOT contract)
+                        self._obs_tpot(req, now - t_dec)
+                    if req.prefill_only:
+                        self._handoff_finish(slot)
+                    else:
+                        self._finish(slot,
+                                     "eos" if done_eos else "length")
+                    self._slots[i] = None
         return len(runnable)
 
     def step(self):
@@ -2591,12 +2848,14 @@ class GenerationEngine:
         step over every decode-phase lane. Returns the number of
         admissions/chunks/lanes that made progress."""
         with RecordEvent("engine.step"):
+            t_wall = time.perf_counter()
             if self.chunked_prefill:
                 progressed = self._admit_chunked()
                 progressed += self._prefill_step()
             else:
                 progressed = self._admit()
             progressed += self._decode_step()
+            self._flush_step_phases(time.perf_counter() - t_wall)
             self._end_of_step_gauges()
             return progressed
 
@@ -2606,6 +2865,13 @@ class GenerationEngine:
         self._update_pool_gauges()
         self._update_adapter_gauges()
         self._sample_traces()
+        if self._m_trace_spans is not None:
+            total = self.tracer.total_recorded
+            self._m_trace_spans.inc(total - self._trace_spans_seen)
+            self._trace_spans_seen = total
+            dropped = self.tracer.dropped
+            self._m_trace_dropped.inc(dropped - self._trace_dropped_seen)
+            self._trace_dropped_seen = dropped
 
     @property
     def num_active(self):
@@ -2683,7 +2949,8 @@ class GenerationEngine:
     def adopt_request(self, prompt, first_token, blocks,
                       max_new_tokens, eos_token_id=None, req_id=None,
                       priority="standard", arrived_at=None,
-                      adapter_id=0, sampling_params=None):
+                      adapter_id=0, sampling_params=None,
+                      trace_id=None):
         """Seat a request whose prompt KV is ALREADY in this engine's
         pool — the decode-side intake of disaggregated serving. The
         fleet allocates `blocks` from this engine's cache, ingests the
@@ -2727,9 +2994,15 @@ class GenerationEngine:
                 "handing off")
         eos = self.eos_token_id if eos_token_id is None \
             else eos_token_id
+        if self.tracing and trace_id is None:
+            trace_id = new_trace_id()
         req = Request(req_id, prompt, int(max_new_tokens), eos,
                       arrived_at=arrived_at, priority=priority,
-                      adapter_id=adapter_id, sampling=sampling_params)
+                      adapter_id=adapter_id, sampling=sampling_params,
+                      trace_id=trace_id)
+        self.flight.record("adopted", req_id, blocks=len(blocks))
+        self._trace_instant("request.adopted", req,
+                            blocks=len(blocks))
         page = self._acquire_adapter(req)
         if page is None:
             raise RuntimeError(
@@ -2770,20 +3043,20 @@ class GenerationEngine:
         self._draining = True
         out = self.run()
         if self._handoffs:
-            raise RuntimeError(
+            raise self._audit_error(
                 f"{len(self._handoffs)} handoff(s) still parked — "
                 "take_handoff/release_handoff them before draining "
                 "the replica")
         leaked = self.cache.leak_check()
         if leaked:
-            raise RuntimeError(
+            raise self._audit_error(
                 f"drain leak check failed: block(s) {leaked} neither "
                 "free nor prefix-cached after all lanes finished — a "
                 "scheduler path dropped a reference without freeing")
         if self.adapter_pool is not None:
             leaked = self.adapter_pool.leak_check()
             if leaked:
-                raise RuntimeError(
+                raise self._audit_error(
                     f"drain leak check failed: adapter page(s) "
                     f"{leaked} still referenced after all lanes "
                     "finished — a scheduler path vacated a lane "

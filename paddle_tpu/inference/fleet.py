@@ -75,6 +75,10 @@ from paddle_tpu.observability.metrics import (LATENCY_BUCKETS,
                                               MetricsRegistry,
                                               label_snapshot,
                                               merge_snapshots)
+from paddle_tpu.observability.tracing import (TraceRecorder,
+                                              export_timeline,
+                                              new_trace_id, now_us,
+                                              profiler_host_events)
 
 __all__ = ["ServingFleet", "REPLICA_ROLES"]
 
@@ -213,6 +217,13 @@ class ServingFleet:
         if affinity_slack is None:
             affinity_slack = self._any_engine().num_slots
         self.affinity_slack = int(affinity_slack)
+        # request-scoped tracing follows the replicas' knob (replicas
+        # are homogeneous): the router keeps its OWN span ring so
+        # routing/handoff decisions land on a separate Perfetto track
+        # from any engine's spans, all on the shared monotonic clock
+        self.tracing = bool(self._any_engine().tracing)
+        self.tracer = TraceRecorder(process_name="fleet.router") \
+            if self.tracing else None
 
     # -- replica management ------------------------------------------------
     def _any_engine(self):
@@ -369,6 +380,29 @@ class ServingFleet:
                 replica=str(rid)))
         return merge_snapshots(snaps)
 
+    def export_trace(self, path, include_profiler=True):
+        """One Perfetto timeline for the whole fleet: the router's
+        routing/handoff spans plus every replica engine's span ring,
+        one track group each (replicas share this process's monotonic
+        clock, so a disaggregated request's prefill, handoff, and
+        decode spans line up — follow its `trace_id` across tracks).
+        Returns the event count written."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "tracing is off — build the fleet with tracing=True "
+                "(or PADDLE_SERVE_TRACING=1) to record spans")
+        groups = [("fleet.router", self.tracer.snapshot())]
+        for rid in sorted(self._replicas):
+            rep = self._replicas[rid]
+            if rep.engine.tracer is not None:
+                groups.append((f"replica {rid} ({rep.role})",
+                               rep.engine.tracer.snapshot()))
+        if include_profiler:
+            ev = profiler_host_events()
+            if ev:
+                groups.append(("profiler", ev))
+        return export_timeline(path, groups)
+
     # -- routing -----------------------------------------------------------
     def _route(self, prompt, adapter_id=0):
         """Pick the intake replica: deepest warm `prefix_key` chain
@@ -474,7 +508,15 @@ class ServingFleet:
             self._m_shed.labels(priority=priority).inc()
             self._done[req_id] = None
             return req_id
+        trace_id = new_trace_id() if self.tracing else None
+        t_route = now_us()
         rep, reason, warm = self._route(prompt, adapter_id)
+        if self.tracer is not None:
+            self.tracer.add_span(
+                "fleet.route", t_route, now_us(), trace_id=trace_id,
+                cat="router",
+                args={"req_id": str(req_id), "replica": rep.rid,
+                      "reason": reason, "affinity_tokens": warm})
         self._m_routed.labels(replica=str(rep.rid),
                               reason=reason).inc()
         if warm:
@@ -489,6 +531,7 @@ class ServingFleet:
                 "arrived": time.perf_counter(), "replica": rep.rid,
                 "adapter_id": int(adapter_id),
                 "sampling": sampling_params,
+                "trace_id": trace_id,
                 "phase": "prefill" if self.disaggregated else "serve"}
         self._requests[req_id] = info
         if self.disaggregated:
@@ -497,13 +540,15 @@ class ServingFleet:
                                    req_id=req_id, priority=priority,
                                    prefill_only=True,
                                    adapter_id=adapter_id,
-                                   sampling_params=sampling_params)
+                                   sampling_params=sampling_params,
+                                   trace_id=trace_id)
         else:
             rep.engine.add_request(prompt, max_new_tokens,
                                    eos_token_id=eos_token_id,
                                    req_id=req_id, priority=priority,
                                    adapter_id=adapter_id,
-                                   sampling_params=sampling_params)
+                                   sampling_params=sampling_params,
+                                   trace_id=trace_id)
         return req_id
 
     def best_of_n(self, prompt, n, max_new_tokens,
@@ -552,6 +597,7 @@ class ServingFleet:
             self._finalize(req_id, toks)
             return
         c = eng.cache
+        t_exp = now_us()
         payload = []
         for b in blocks:
             if c.scales is not None:
@@ -561,6 +607,12 @@ class ServingFleet:
                 payload.append(rep._export(c.kpool, c.vpool,
                                            jnp.int32(b)))
         eng.release_handoff(blocks)
+        if self.tracer is not None:
+            self.tracer.add_span(
+                "handoff.export", t_exp, now_us(),
+                trace_id=info.get("trace_id"), cat="handoff",
+                args={"req_id": str(req_id), "from_replica": rep.rid,
+                      "blocks": len(blocks)})
         info["phase"] = "handoff"
         self._pending_handoffs.append(
             {"req_id": req_id, "payload": payload, "first": first,
@@ -596,6 +648,7 @@ class ServingFleet:
             return False
         eng = rep.engine
         c = eng.cache
+        t_ing = now_us()
         for parts, dst in zip(h["payload"], blocks):
             if c.scales is not None:
                 kb, vb, srow = parts
@@ -613,7 +666,14 @@ class ServingFleet:
                           priority=info["priority"],
                           arrived_at=info["arrived"],
                           adapter_id=info.get("adapter_id", 0),
-                          sampling_params=info.get("sampling"))
+                          sampling_params=info.get("sampling"),
+                          trace_id=info.get("trace_id"))
+        if self.tracer is not None:
+            self.tracer.add_span(
+                "handoff.ingest", t_ing, now_us(),
+                trace_id=info.get("trace_id"), cat="handoff",
+                args={"req_id": str(req_id), "to_replica": rep.rid,
+                      "blocks": need})
         info["phase"] = "decode"
         info["replica"] = rep.rid
         self._m_handoffs.inc()

@@ -29,6 +29,15 @@ from .metrics import (
 from .aggregate import aggregate
 from .exposition import parse_prometheus, render_prometheus
 from .server import MetricsServer
+from .tracing import (
+    STEP_PHASES,
+    FlightRecorder,
+    PhaseTimer,
+    TraceRecorder,
+    export_timeline,
+    merge_trace_events,
+    new_trace_id,
+)
 from .training import TrainingTelemetry
 
 __all__ = [
@@ -37,4 +46,6 @@ __all__ = [
     "quantile_from_buckets", "series_total", "aggregate",
     "render_prometheus", "parse_prometheus", "MetricsServer",
     "TrainingTelemetry",
+    "TraceRecorder", "PhaseTimer", "FlightRecorder", "STEP_PHASES",
+    "new_trace_id", "merge_trace_events", "export_timeline",
 ]

@@ -2,6 +2,7 @@ from __future__ import annotations
 
 import contextlib
 import enum
+import itertools
 import json
 import os
 import threading
@@ -42,6 +43,13 @@ class _HostEventRecorder:
             out = self.events
             self.events = []
         return out
+
+    def peek(self):
+        """Non-destructive copy of the buffered spans — the tracing
+        timeline merge (`observability.tracing.export_timeline`) reads
+        the stream without stealing it from a recording Profiler."""
+        with self._lock:
+            return [dict(e) for e in self.events]
 
 
 _recorder = _HostEventRecorder()
@@ -96,11 +104,19 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
     return schedule
 
 
+# monotonic export sequence: two exports within the same wall-clock
+# second (scheduler cycles faster than 1 Hz, tests) must land in two
+# files — `{name}_{epoch}.json` alone silently overwrites the first
+_export_seq = itertools.count()
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"worker_{os.getpid()}"
-        path = os.path.join(dir_name, f"{name}_{int(time.time())}.json")
+        path = os.path.join(
+            dir_name,
+            f"{name}_{int(time.time())}_{next(_export_seq):04d}.json")
         prof._export_path = path
         prof.export(path)
 

@@ -572,3 +572,71 @@ def test_alpha_with_mixed_ranks_is_rejected(model):
         reg.register(1, w, alpha=16)
     reg.register(1, w, scaling=2.0)      # explicit scaling is fine
     assert reg.scaling_of(1) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# live registration (ISSUE 17 satellite: the PR 13 operational tail)
+# ---------------------------------------------------------------------------
+
+def _adapter_weights(cfg, rank, seed, scale=0.3):
+    """One adapter's weight dict, deterministic in `seed` — so two
+    registries built on different schedules can hold bit-identical
+    factors for the same adapter id."""
+    rng = np.random.RandomState(seed)
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    w = {}
+    for site, (i_d, o_d) in (("qkv", (H, 3 * H)), ("out", (H, H)),
+                             ("fc1", (H, I)), ("fc2", (I, H))):
+        w[site] = [(rng.randn(rank, i_d).astype(np.float32) * scale,
+                    rng.randn(o_d, rank).astype(np.float32) * scale)
+                   for _ in range(L)]
+    return w
+
+
+def test_live_adapter_registration_token_identical(model):
+    """Registering a NEW adapter on a registry already wired into a
+    serving engine is legal (no construction-time freeze) and the
+    late tenant's streams are token-identical to an engine whose
+    registry carried it from the start — with tracing ON, the cold
+    swap-in shows up as a labeled `adapter.swap_in` span and
+    `decode_traces == 1` survives the tenant-set growth."""
+    cfg = model.config
+    w1 = _adapter_weights(cfg, 2, seed=21)
+    w2 = _adapter_weights(cfg, 3, seed=22)
+
+    def mk(reg, tracing=False):
+        return GenerationEngine(model, num_slots=2, block_size=4,
+                                num_blocks=64, prefill_chunk=8,
+                                adapters=reg, tracing=tracing)
+
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(0, VOCAB, rng.randint(3, 10))
+             .astype(np.int32), int(rng.randint(2, 6)), aid)
+            for aid in (0, 2, 1, 2) for _ in range(1)]
+
+    # reference: adapter 2 present before the engine ever existed
+    reg_ref = AdapterRegistry(cfg, max_rank=4)
+    reg_ref.register(1, w1, scaling=0.5)
+    reg_ref.register(2, w2, scaling=0.5)
+    ref = _serve(mk(reg_ref), reqs, midrun=False)
+
+    # live path: engine built with ONLY adapter 1; tenant 2 arrives
+    # after construction — and after the engine has already served
+    reg_live = AdapterRegistry(cfg, max_rank=4)
+    reg_live.register(1, w1, scaling=0.5)
+    eng = mk(reg_live, tracing=True)
+    warm = [r for r in reqs if r[2] != 2]
+    pre = _serve(eng, warm, midrun=False)
+    assert pre == [t for t, r in zip(ref, reqs) if r[2] != 2]
+    with pytest.raises(ValueError, match="is not registered"):
+        eng.add_request(reqs[0][0], 2, adapter_id=2)
+    reg_live.register(2, w2, scaling=0.5)          # live registration
+    late = _serve(eng, reqs, midrun=False)
+    assert late == ref
+    assert eng.decode_traces == 1
+    swaps = [e for e in eng.tracer.snapshot()
+             if e["name"] == "adapter.swap_in"]
+    assert any(e["args"]["adapter"] == 2 for e in swaps)
+    # the live id is still guarded: re-registering it raises
+    with pytest.raises(ValueError, match="already registered"):
+        reg_live.register(2, w2, scaling=0.5)

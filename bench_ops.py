@@ -108,7 +108,7 @@ SUITE_ROWS = (
     "gpt_engine_multitenant_lora", "gpt_engine_sampling",
     "conv_fused_sweep", "resnet50_fused_block",
     "conv_fused_bwd_sweep", "resnet50_fused_block_train",
-    "gpt_engine_host_gap",
+    "gpt_engine_host_gap", "gpt_engine_async_overlap",
 )
 
 
@@ -222,6 +222,7 @@ def suite():
     cases["resnet50_fused_block_train"] = \
         _resnet50_fused_block_train_case()
     cases["gpt_engine_host_gap"] = _engine_host_gap_case()
+    cases["gpt_engine_async_overlap"] = _engine_async_overlap_case()
     # every suite() caller trips on drift immediately, not just the one
     # CI test — SUITE_ROWS must stay the cheap names-only mirror
     assert tuple(cases) == SUITE_ROWS, \
@@ -1752,6 +1753,173 @@ def _engine_host_gap_case(model_cfg=None, num_requests=12,
                 ms_warm = dt_warm * 1e3
         return {"ms": round(ms_warm, 1), **rec,
                 "requests": num_requests}
+
+    return run_bench
+
+
+def _engine_async_overlap_case(model_cfg=None, num_requests=24,
+                               num_slots=2, block_size=16, max_new=6,
+                               spec_k=4, reps=3, seed=0):
+    """Async-core overlap row (ISSUE 18 — the refactor the host-gap
+    row was built to measure): the SAME offered-load trace served by a
+    persistent serial engine (`async_core=False`) and a persistent
+    async engine (`async_core=True`), interleaved serial/async for
+    `reps` measured passes, every pass asserted token-identical.
+
+    The workload is built to have real overlappable host work, not
+    just scheduler arithmetic: requests cycle FOUR tenant adapters
+    over a pool with three usable pages and two lanes, so in steady
+    state the queue head's adapter is never resident — the serial
+    engine pays the host->device swap-in inside the admission path's
+    `adapter_swap` phase, while the async core prefetches that page
+    behind the in-flight step (stage 5 of `_step_async`) and admits
+    against a resident hit. Drafter proposals ride the helper thread
+    against the admission/prefill work of the same step.
+
+    Reported: per-phase host-gap ms/step and device fraction for both
+    modes (median across reps — the CPU runner's step costs are
+    ms-scale where machine noise lives). Asserted where measured: the
+    async overlappable host gap (schedule + draft_propose +
+    adapter_swap) strictly below serial's, async device fraction no
+    lower — the ROADMAP item 3 claim."""
+
+    def run_bench():
+        import os
+        import time
+
+        import numpy as np
+
+        import paddle_tpu  # noqa: F401
+        from paddle_tpu.adapters import AdapterRegistry
+        from paddle_tpu.inference import GenerationEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+        if os.environ.get("PADDLE_SERVE_ASYNC") not in (None, ""):
+            # the row IS the serial-vs-async comparison; a global env
+            # override would silently collapse both arms to one mode
+            raise RuntimeError(
+                "unset PADDLE_SERVE_ASYNC to run the async-overlap "
+                "row (it builds both modes explicitly)")
+        cfg = model_cfg or GPTConfig(
+            vocab_size=50304, hidden_size=1024, num_layers=24,
+            num_heads=16, max_seq_len=512)
+        rng = np.random.RandomState(seed)
+        # repeat-heavy prompts from a small alphabet: the NgramDrafter
+        # actually matches (and costs real host time) instead of
+        # no-op'ing on unrepeated random ids
+        alpha = min(64, cfg.vocab_size)
+        hi = min(97, cfg.max_seq_len - max_new)
+        lo = min(32, hi - 1)
+        reqs = [(rng.randint(0, alpha,
+                             rng.randint(lo, hi)).astype(np.int32),
+                 1 + i % 4)             # cycle adapters 1..4
+                for i in range(num_requests)]
+        model = GPTForCausalLM(cfg)
+        model.eval()
+
+        def registry():
+            # four tenants over three usable pages: the steady-state
+            # queue head is never resident, every admission pays (or
+            # prefetches) a swap-in
+            w_rng = np.random.RandomState(7)
+            reg = AdapterRegistry(cfg, max_rank=4)
+            H, I = cfg.hidden_size, cfg.intermediate_size
+            L = cfg.num_layers
+            for aid in (1, 2, 3, 4):
+                w = {"qkv": [(w_rng.randn(2, H).astype(np.float32)
+                              * 0.01,
+                              w_rng.randn(3 * H, 2).astype(np.float32)
+                              * 0.01)
+                             for _ in range(L)]}
+                reg.register(aid, w, scaling=0.25)
+            return reg
+
+        def build(async_core):
+            engine = GenerationEngine(
+                model, num_slots=num_slots, block_size=block_size,
+                spec_decode_k=spec_k, tracing=True,
+                adapters=registry(), adapter_pool_pages=4,
+                async_core=async_core)
+            if not engine.tracing:
+                raise RuntimeError(
+                    "bench row requested tracing=True but the engine "
+                    "resolved tracing off (is PADDLE_SERVE_TRACING "
+                    "set?) — unset it to run this row")
+            assert engine.async_core == async_core
+            return engine
+
+        def serve(engine):
+            t0 = time.perf_counter()
+            ids = [engine.add_request(p, max_new_tokens=max_new,
+                                      req_id=i, adapter_id=aid)
+                   for i, (p, aid) in enumerate(reqs)]
+            out = engine.run()
+            dt = time.perf_counter() - t0
+            return dt, [list(map(int, out[i])) for i in ids]
+
+        def phase_report(engine):
+            snap = engine.metrics_snapshot()
+            series = snap["engine_step_host_gap_seconds"]["series"]
+            per_step, sums = {}, {}
+            for s in series:
+                if not s["count"]:
+                    continue
+                ph = s["labels"]["phase"]
+                sums[ph] = s["sum"]
+                per_step[ph] = round(s["sum"] / s["count"] * 1e3, 4)
+            total = sum(sums.values())
+            frac = round(sums.get("device_wait", 0.0) / total, 4) \
+                if total else 0.0
+            overlap = sum(sums.get(p, 0.0) for p in
+                          ("schedule", "draft_propose", "adapter_swap"))
+            return per_step, frac, overlap
+
+        def median(xs):
+            xs = sorted(xs)
+            return xs[len(xs) // 2]
+
+        engines = {"serial": build(False), "async": build(True)}
+        for eng in engines.values():
+            serve(eng)                         # cold: compiles land
+        samples = {m: [] for m in engines}
+        for rep in range(reps):                # interleaved: machine
+            tokens = {}                        # drift hits both modes
+            for mode, eng in engines.items():
+                eng.metrics.reset()
+                dt, tokens[mode] = serve(eng)
+                warm, frac, overlap = phase_report(eng)
+                samples[mode].append(
+                    {"phases": warm, "frac": frac,
+                     "overlap_ms": overlap * 1e3, "serve_ms": dt * 1e3})
+            assert tokens["async"] == tokens["serial"], \
+                f"async core diverged from the serial stream (rep {rep})"
+        rec = {}
+        for mode, ss in samples.items():
+            mid = median([s["overlap_ms"] for s in ss])
+            rec[mode] = {
+                "phase_ms_per_step_warm":
+                    ss[[s["overlap_ms"] for s in ss].index(mid)]
+                    ["phases"],
+                "device_fraction_warm":
+                    median([s["frac"] for s in ss]),
+                "host_overlap_gap_ms": round(mid, 3),
+                "serve_ms_warm":
+                    round(median([s["serve_ms"] for s in ss]), 1),
+            }
+        # the remaining two gates, asserted where they're measured
+        # (stream identity was asserted per rep above): a strictly
+        # smaller overlappable host gap, a device fraction that did
+        # not regress
+        assert rec["async"]["host_overlap_gap_ms"] \
+            < rec["serial"]["host_overlap_gap_ms"], (
+                "async host gap (schedule+draft_propose+adapter_swap) "
+                f"not below serial: {rec['async']} vs {rec['serial']}")
+        assert rec["async"]["device_fraction_warm"] \
+            >= rec["serial"]["device_fraction_warm"], (
+                "async device fraction regressed vs serial: "
+                f"{rec['async']} vs {rec['serial']}")
+        return {"ms": rec["async"]["serve_ms_warm"], **rec,
+                "requests": num_requests, "k": spec_k, "reps": reps}
 
     return run_bench
 

@@ -239,18 +239,48 @@ class PagedAdapterPool:
             return 0
         return self._page_of.get(aid)
 
+    def _group_sibling_page(self, aid):
+        """The page a RANK-GROUP sibling of `aid` currently occupies
+        (None when ungrouped or no sibling is resident). A rank group
+        — one tenant's adapter at several ranks — shares ONE page
+        budget, so the sibling's page is where this adapter must land
+        (idle sibling) or why it must stall (referenced sibling)."""
+        group = self.registry.group_of(aid)
+        if group is None:
+            return None
+        for sib in self.registry.group_ids(group):
+            if sib != aid:
+                page = self._page_of.get(sib)
+                if page is not None:
+                    return page
+        return None
+
     def can_acquire(self, adapter_id):
-        """True when `acquire` would succeed right now (resident, or a
-        page is free/evictable) — the fleet's placement probe."""
+        """True when `acquire` would succeed right now (resident, a
+        page is free/evictable, or the rank group's shared page sits
+        idle) — the fleet's placement probe."""
         aid = int(adapter_id)
-        return aid == NULL_ADAPTER_ID or aid in self._page_of \
-            or self.num_free > 0
+        if aid == NULL_ADAPTER_ID or aid in self._page_of:
+            return True
+        sib_page = self._group_sibling_page(aid)
+        if sib_page is not None:
+            # the group's one-page budget: free only while no live
+            # lane references the sibling variant
+            return self._ref[sib_page] == 0
+        return self.num_free > 0
 
     def acquire(self, adapter_id):
         """One reference on the adapter's page, swapping it in from
         the registry on miss. Returns the page id, or None when every
         page is referenced by a live lane (caller stalls/retries — the
-        KV allocator's contract). Unknown ids raise."""
+        KV allocator's contract). Unknown ids raise.
+
+        Rank groups (`AdapterRegistry.register(..., group=...)`) share
+        ONE page budget: a miss whose idle sibling is resident evicts
+        the sibling and reuses its page in place (counted as eviction
+        + swap-in), and a miss whose sibling is still referenced
+        stalls — switching rank variants never grows the group's pool
+        footprint."""
         aid = int(adapter_id)
         if aid == NULL_ADAPTER_ID:
             return 0
@@ -261,7 +291,16 @@ class PagedAdapterPool:
                 del self._evictable[page]      # revive: live again
             self._ref[page] += 1
             return page
-        if self._free:
+        sib_page = self._group_sibling_page(aid)
+        if sib_page is not None:
+            if self._ref[sib_page] > 0:
+                return None        # group budget busy: stall/retry
+            page = sib_page
+            del self._evictable[page]
+            del self._page_of[self._adapter_of[page]]
+            del self._adapter_of[page]
+            self.evictions += 1
+        elif self._free:
             page = self._free.pop()
         elif self._evictable:
             page, cold = self._evictable.popitem(last=False)
@@ -275,6 +314,29 @@ class PagedAdapterPool:
         self._ref[page] = 1
         self._page_of[aid] = page
         self._adapter_of[page] = aid
+        return page
+
+    def prefetch(self, adapter_id):
+        """Warm an adapter's page WITHOUT keeping a reference: swap in
+        on miss, then park it refcount-zero in the warm LRU so the
+        NEXT `acquire` is a resident hit. Returns the page id, or None
+        when no page is obtainable right now (same stall contract as
+        `acquire` — prefetch never blocks, never evicts a live page).
+
+        This is the async engine core's latency hider: the host cost
+        is one compiled swap-in DISPATCH (the page copy itself runs
+        async on device, overlapping the in-flight decode step), so
+        admission-time `acquire` finds the page already resident
+        instead of paying the copy in the host gap."""
+        aid = int(adapter_id)
+        if aid == NULL_ADAPTER_ID:
+            return 0
+        if aid in self._page_of:
+            return self._page_of[aid]          # already warm/live
+        page = self.acquire(aid)
+        if page is None:
+            return None
+        self.release(aid)                      # park warm, evictable
         return page
 
     def release(self, adapter_id):
@@ -296,14 +358,25 @@ class PagedAdapterPool:
     def leak_check(self):
         """Page-accounting audit for a QUIESCED pool (no live lanes):
         every non-null page must be on the free list or parked
-        refcount-zero in the warm LRU. Returns leaked page ids —
+        refcount-zero in the warm LRU, and no rank group may hold more
+        than its one-page budget. Returns leaked page ids —
         `GenerationEngine.drain()` asserts this empty, so a lane that
-        finished without releasing its adapter page fails as loudly as
-        a leaked KV block."""
+        finished without releasing its adapter page (or an acquire
+        path that let a rank group spread over two pages) fails as
+        loudly as a leaked KV block."""
         free = set(self._free)
         leaked = []
         for p in range(1, self.num_pages):
             if self._ref[p] == 0 and (p in free or p in self._evictable):
                 continue
             leaked.append(p)
+        group_page = {}
+        for aid, p in self._page_of.items():
+            group = self.registry.group_of(aid)
+            if group is None:
+                continue
+            if group in group_page:
+                leaked.append(p)       # a second page for one group
+            else:
+                group_page[group] = p
         return leaked

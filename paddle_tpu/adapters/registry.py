@@ -54,6 +54,7 @@ class AdapterRegistry:
                 f"num_heads={self.num_heads}")
         self.head_dim = self.hidden_size // self.num_heads
         self._adapters = {}            # id -> {site stacks + scaling}
+        self._groups = {}              # group key -> set of ids
 
     # -- site geometry ----------------------------------------------------
     def site_dims(self, site):
@@ -63,14 +64,23 @@ class AdapterRegistry:
                 "fc2": (I, H)}[site]
 
     # -- registration -----------------------------------------------------
-    def register(self, adapter_id, weights, scaling=None, alpha=None):
+    def register(self, adapter_id, weights, scaling=None, alpha=None,
+                 group=None):
         """Register one tenant's adapter. `weights` maps a site name
         (one of LORA_SITES) to a per-layer sequence of `(A, B)` pairs
         (None skips a layer). A is `[rank, in]`, B `[out, rank]`,
         rank <= max_rank — rank-padded to the fixed pool shape with
         exact zeros. `scaling` defaults to `alpha / rank` (alpha given)
         or 1.0. Re-registering a live id raises — tenants update via a
-        new id, so a pool page can never silently serve stale bytes."""
+        new id, so a pool page can never silently serve stale bytes.
+
+        `group` (any hashable key) declares a RANK GROUP: one tenant's
+        adapter shipped at several ranks (quality/latency variants of
+        the same LoRA — the grouped multi-rank tail of the paged-pool
+        design). Members of a group share ONE page budget in the paged
+        pool: acquiring one variant reuses (and evicts) an idle
+        sibling's page in place instead of taking a second page, and
+        the pool's leak audit asserts no group ever holds two."""
         aid = int(adapter_id)
         if aid == NULL_ADAPTER_ID:
             raise ValueError(
@@ -142,7 +152,10 @@ class AdapterRegistry:
         elif alpha is not None:
             raise ValueError("pass scaling OR alpha, not both")
         entry["scaling"] = float(scaling)
+        entry["group"] = group
         self._adapters[aid] = entry
+        if group is not None:
+            self._groups.setdefault(group, set()).add(aid)
         return aid
 
     def _b_layout(self, site, b_stack):
@@ -176,6 +189,18 @@ class AdapterRegistry:
         if int(adapter_id) == NULL_ADAPTER_ID:
             return 0.0
         return self._adapters[int(adapter_id)]["scaling"]
+
+    def group_of(self, adapter_id):
+        """The rank-group key an adapter was registered under (None
+        for ungrouped adapters and the null adapter)."""
+        aid = int(adapter_id)
+        if aid == NULL_ADAPTER_ID or aid not in self._adapters:
+            return None
+        return self._adapters[aid].get("group")
+
+    def group_ids(self, group):
+        """Sorted member ids of one rank group (empty when unknown)."""
+        return sorted(self._groups.get(group, ()))
 
     def stacks(self, adapter_id):
         """The pool-layout host arrays of one adapter:

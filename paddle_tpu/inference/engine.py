@@ -187,6 +187,7 @@ from __future__ import annotations
 import hashlib
 import math
 import os
+import threading
 import time
 from collections import OrderedDict, deque
 from contextlib import contextmanager
@@ -202,6 +203,7 @@ from paddle_tpu.analysis.trace.contracts import TraceContract, \
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.inference.sampling import SamplingParams
 from paddle_tpu.inference.sampling import key_row as _sampling_key_row
+from paddle_tpu.inference.speculative import draft_window
 from paddle_tpu.jit import introspect
 from paddle_tpu.jit.api import bound_state, count_traces, dedup_params, \
     model_buffers
@@ -687,6 +689,23 @@ class _Slot:
             else int(self.req.prompt[-1])
 
 
+@dataclass(eq=False)
+class _InFlight:
+    """The single in-flight result slot of the dispatch-ahead
+    pipeline: one dispatched decode/verify step whose device output
+    has NOT been waited on yet. The async core leaves exactly one of
+    these across `step()` calls (depth 1 — see DESIGN_DECISIONS r21);
+    the serial core completes it inline within the same step."""
+
+    out: object                        # device output(s), not yet read
+    runnable: list                     # lane indices dispatched
+    slots: list                        # the _Slot objects, snapshotted
+    drafts: dict = None                # lane -> draft (verify steps)
+    t_dec: float = 0.0                 # perf_counter at dispatch
+    t_span: int = 0                    # now_us at schedule end
+    seq: int = 0                       # pipeline sequence number
+
+
 class GenerationEngine:
     """Iteration-level scheduler + compiled steps over a paged cache.
 
@@ -712,7 +731,7 @@ class GenerationEngine:
                  weight_dtype=None, adapters=None,
                  adapter_pool_pages=None, sampling=None,
                  tracing=None, trace_capacity=4096,
-                 flight_capacity=256):
+                 flight_capacity=256, async_core=None):
         from paddle_tpu.ops.paged_attention import (copy_pool_block,
                                                     resolve_backend)
 
@@ -791,6 +810,22 @@ class GenerationEngine:
             "PADDLE_SERVE_TRACING", tracing)
         self.tracer = TraceRecorder(capacity=trace_capacity) \
             if self.tracing else None
+        # async engine core (ROADMAP item 3): a one-step dispatch-ahead
+        # pipeline — `step()` leaves the decode/verify dispatch IN
+        # FLIGHT and the next call's host work (admissions, prefill
+        # chunk, drafter proposals on a helper thread, adapter-page
+        # prefetch) overlaps its device time. Pure host restructuring:
+        # the compiled programs are byte-identical and the emitted
+        # token streams token-identical to the serial core (CI's
+        # serial-vs-async parity matrix). Env override wins
+        # (deploy-time knob, like the backend); off (the default)
+        # keeps today's serial step loop op-for-op.
+        self.async_core = self._resolve_bool_knob(
+            "PADDLE_SERVE_ASYNC", async_core)
+        self._inflight = None          # the single in-flight step slot
+        self._ahead = None             # (helper thread, results dict)
+        self._next_drafts = {}         # slot -> precomputed draft
+        self._step_seq = 0
         # the flight recorder and the step-phase clock are ALWAYS on:
         # both are bounded host-side bookkeeping (a few appends /
         # perf_counter calls per step) and they feed the always-on
@@ -1054,12 +1089,12 @@ class GenerationEngine:
                     top_p=float(p.top_p),
                     key_row=_sampling_key_row(p.seed))
 
-    def _sampling_host_args(self):
-        """The four traced per-row sampling arrays of one decode/verify
-        dispatch: [slots] temperature/top-k/top-p plus the [slots, 2]
-        uint32 key rows. Idle and greedy lanes ride temp 0 / zero keys
-        — their sampled columns are garbage the argmax select (device)
-        and the host both ignore."""
+    def _sampling_host_rows(self):
+        """The four per-row sampling arrays of one decode/verify
+        dispatch as RAW NUMPY: [slots] temperature/top-k/top-p plus
+        the [slots, 2] uint32 key rows. Idle and greedy lanes ride
+        temp 0 / zero keys — their sampled columns are garbage the
+        argmax select (device) and the host both ignore."""
         temps = np.zeros(self.num_slots, np.float32)
         tks = np.zeros(self.num_slots, np.int32)
         tps = np.ones(self.num_slots, np.float32)
@@ -1071,8 +1106,30 @@ class GenerationEngine:
             tks[i] = slot.top_k
             tps[i] = slot.top_p
             keys[i] = slot.key_row
-        return [jnp.asarray(temps), jnp.asarray(tks),
-                jnp.asarray(tps), jnp.asarray(keys)]
+        return [temps, tks, tps, keys]
+
+    def _sampling_host_args(self):
+        """`_sampling_host_rows` as device arrays (the prefill paths'
+        per-dispatch transfer; the decode paths batch the rows through
+        `_put_host_args` instead)."""
+        return [jnp.asarray(a) for a in self._sampling_host_rows()]
+
+    def _put_host_args(self, rows):
+        """Move one step's dynamic host rows to the device. Serial
+        core: one `jnp.asarray` per row, in row order — op-for-op
+        today's path. Async core: ONE fused `jax.device_put` over the
+        whole tree (positions, draft windows, sampling rows, page rows
+        ride a single transfer instead of 3-8 round trips). The leaf
+        avals are identical either way, so the compiled step programs
+        — and TRACE_BASELINE.json — cannot move."""
+        if not self.async_core:
+            return [jnp.asarray(a) for a in rows]
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return list(jax.device_put(
+                tuple(rows), NamedSharding(self.mesh, PartitionSpec())))
+        return list(jax.device_put(tuple(rows)))
 
     @staticmethod
     def _sampling_host_args_one(slot):
@@ -2498,9 +2555,30 @@ class GenerationEngine:
         holds an exclusively-writable block for its write position.
         Copy-on-write happens here: a lane whose feed position sits in
         a shared or prefix-cached block first gets a private copy via
-        the compiled block-copy step."""
+        the compiled block-copy step.
+
+        SERIAL core: schedule, dispatch, and complete run inline in
+        this one call — the same operations in the same order as the
+        pre-pipeline engine. The ASYNC core drives the same three
+        stages through `_dispatch_ahead`/`_complete_inflight`, with
+        the complete of step N and the dispatch of step N+1 split
+        across `step()` calls."""
         if self.spec_decode_k:
-            return self._spec_decode_step()
+            runnable, drafts = self._spec_schedule()
+            if not runnable:
+                return 0
+            inflight = self._spec_dispatch(runnable, drafts)
+            return self._spec_complete(inflight, synced=False)
+        runnable = self._plain_schedule()
+        if not runnable:
+            return 0
+        inflight = self._plain_dispatch(runnable)
+        return self._plain_complete(inflight, synced=False)
+
+    def _plain_schedule(self):
+        """Schedule stage of a plain decode step: on-demand block
+        growth + COW promotion per decode-phase lane; returns the
+        runnable lane indices."""
         runnable = []
         with self._phase("schedule"):
             for i, slot in enumerate(self._slots):
@@ -2527,8 +2605,13 @@ class GenerationEngine:
                     if not self._cow_promote(slot, bi):
                         continue       # pool pressure: stalled
                 runnable.append(i)
-        if not runnable:
-            return 0
+        return runnable
+
+    def _plain_dispatch(self, runnable):
+        """Dispatch stage of a plain decode step: build the dynamic
+        host rows, move them in one `_put_host_args` batch, and issue
+        the compiled step WITHOUT waiting on its output. Returns the
+        `_InFlight` record the complete stage consumes."""
         t_span = now_us()
         with self._phase("dispatch"):
             tokens = np.zeros((self.num_slots, 1), np.int32)
@@ -2542,30 +2625,46 @@ class GenerationEngine:
                 positions[i] = slot.feed_pos
                 tables[i, :len(slot.blocks)] = slot.blocks
                 arows[i] = slot.adapter_page
-            args = [jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(tables)]
+            rows = [tokens, positions, tables]
             if self.sampling:
                 # per-slot sampling rows (idle/greedy lanes ride temp
                 # 0 — the argmax select, like the null block)
-                args.extend(self._sampling_host_args())
+                rows.extend(self._sampling_host_rows())
             if self.adapter_pool is not None:
                 # per-slot adapter page row (idle/stalled lanes ride
                 # the null page 0 — exact-zero delta, like the null
                 # block)
-                args.append(jnp.asarray(arows))
+                rows.append(arows)
+            args = self._put_host_args(rows)
             with RecordEvent("engine.decode"):
                 t_dec = time.perf_counter()
                 nxt = self._dispatch_step(self._decode, *args)
-                with self._phase("device_wait"):
-                    nxt = np.asarray(nxt)  # sync: tokens are out
-                self._m_decode_seconds.observe(
-                    time.perf_counter() - t_dec)
-        self._trace_span("decode.step", t_span, cat="engine",
-                         lanes=len(runnable))
+        self._step_seq += 1
+        return _InFlight(out=nxt, runnable=runnable,
+                         slots=[self._slots[i] for i in runnable],
+                         t_dec=t_dec, t_span=t_span,
+                         seq=self._step_seq)
+
+    def _plain_complete(self, inflight, synced):
+        """Complete stage of a plain decode step: sync on the device
+        output, then the per-lane finish walk. `synced=False` is the
+        serial core — the np.asarray IS the device sync, measured as
+        `device_wait`; `synced=True` is the async core, where
+        `_complete_inflight` already blocked (the true residual) and
+        this conversion is only a host copy."""
+        if synced:
+            nxt = np.asarray(inflight.out)
+        else:
+            with self._phase("device_wait"):
+                nxt = np.asarray(inflight.out)  # sync: tokens are out
+        self._m_decode_seconds.observe(
+            time.perf_counter() - inflight.t_dec)
+        self._trace_span("decode.step", inflight.t_span, cat="engine",
+                         lanes=len(inflight.runnable))
+        t_dec = inflight.t_dec
         now = time.perf_counter()
         with self._phase("finish"):
-            for i in runnable:
-                slot = self._slots[i]
+            for i, slot in zip(inflight.runnable, inflight.slots):
                 tok = int(nxt[i])
                 is_first = not slot.generated   # full-prefix-hit lane
                 slot.generated.append(tok)
@@ -2603,22 +2702,21 @@ class GenerationEngine:
                         self._finish(slot,
                                      "eos" if done_eos else "length")
                     self._slots[i] = None
-        return len(runnable)
+        return len(inflight.runnable)
 
-    def _spec_decode_step(self):
-        """One speculative verify step: draft up to K tokens per
-        decode-phase lane (host-side, between compiled steps), grow
-        and COW-protect every block the `[feed_pos, feed_pos+k]` write
-        window touches, score all K+1 positions in ONE compiled pass,
-        and emit the longest draft prefix the target's argmax confirms
-        plus the target's own next token. Rejection is rollback by
-        position: the lane simply does not advance past the accepted
-        prefix, so the rejected rows' KV is unreachable (attention is
-        position-bounded) until the next window overwrites it. A lane
-        that cannot get blocks for its window degrades to a draftless
-        (plain-decode) window before it stalls."""
+    def _spec_schedule(self):
+        """Schedule stage of a speculative verify step: draft up to K
+        tokens per decode-phase lane (host-side, between compiled
+        steps — or joined from the async core's drafter thread via
+        `_next_drafts`), then grow and COW-protect every block the
+        `[feed_pos, feed_pos+k]` write window touches. Rejection is
+        rollback by position: the lane simply does not advance past
+        the accepted prefix, so the rejected rows' KV is unreachable
+        (attention is position-bounded) until the next window
+        overwrites it. A lane that cannot get blocks for its window
+        degrades to a draftless (plain-decode) window before it
+        stalls. Returns (runnable lane indices, lane -> draft)."""
         K = self.spec_decode_k
-        W = K + 1
         bs = self.block_size
         vocab = self.model.config.vocab_size
         runnable, drafts = [], {}
@@ -2637,13 +2735,16 @@ class GenerationEngine:
                 draft = []
                 if budget > 0:
                     with self._phase("draft_propose"):
-                        for t in self.drafter.propose(
-                                req.prompt, slot.generated, budget):
-                            t = int(t)
-                            if not 0 <= t < vocab \
-                                    or len(draft) >= budget:
-                                break  # junk proposal: verify nothing
-                            draft.append(t)
+                        # async core: the drafter thread proposed this
+                        # window from the SAME post-walk context while
+                        # admissions ran — identical inputs, identical
+                        # draft (the serial-vs-async identity gate).
+                        # Serial core / fresh lanes: propose inline.
+                        draft = self._next_drafts.pop(slot, None)
+                        if draft is None:
+                            draft = draft_window(
+                                self.drafter, req.prompt,
+                                slot.generated, budget, vocab)
                 # grow the table to cover the window's last write;
                 # under pool pressure shed the draft (plain one-token
                 # window) before stalling the lane outright
@@ -2711,8 +2812,15 @@ class GenerationEngine:
                         continue       # truly stalled this iteration
                 drafts[i] = draft
                 runnable.append(i)
-        if not runnable:
-            return 0
+        return runnable, drafts
+
+    def _spec_dispatch(self, runnable, drafts):
+        """Dispatch stage of a speculative verify step: score all K+1
+        positions of every runnable lane in ONE compiled pass, issued
+        without waiting (one fused `_put_host_args` transfer for the
+        dynamic rows). Returns the `_InFlight` record."""
+        K = self.spec_decode_k
+        W = K + 1
         t_span = now_us()
         with self._phase("dispatch"):
             tokens = np.zeros((self.num_slots, W), np.int32)
@@ -2731,35 +2839,59 @@ class GenerationEngine:
                 dlens[i] = len(d)
                 tables[i, :len(slot.blocks)] = slot.blocks
                 arows[i] = slot.adapter_page
-            args = [jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray(dlens), jnp.asarray(tables)]
+            rows = [tokens, positions, dlens, tables]
             if self.sampling:
-                args.extend(self._sampling_host_args())
+                rows.extend(self._sampling_host_rows())
             if self.adapter_pool is not None:
-                args.append(jnp.asarray(arows))
+                rows.append(arows)
+            args = self._put_host_args(rows)
             with RecordEvent("engine.decode"):
                 t_dec = time.perf_counter()
                 out_dev = self._dispatch_step(self._decode, *args,
                                               n_out=self._decode_n_out)
-                with self._phase("device_wait"):
-                    if self.sampling:
-                        # sync: per-row stop-choices + accept flags
-                        choices = np.asarray(out_dev[0])
-                        accepts = np.asarray(out_dev[1])
-                        nxt = None
-                    else:
-                        # sync: [slots, K+1] argmaxes
-                        nxt = np.asarray(out_dev)
-                self._m_decode_seconds.observe(
-                    time.perf_counter() - t_dec)
-        self._trace_span("decode.verify", t_span, cat="engine",
-                         lanes=len(runnable), k=K)
+        self._step_seq += 1
+        return _InFlight(out=out_dev, runnable=runnable,
+                         slots=[self._slots[i] for i in runnable],
+                         drafts=drafts, t_dec=t_dec, t_span=t_span,
+                         seq=self._step_seq)
+
+    def _spec_complete(self, inflight, synced):
+        """Complete stage of a speculative verify step: sync on the
+        verify output, then emit the longest draft prefix the target
+        confirms plus the target's own next token, per lane. The
+        acceptance/sample walks stay on the step thread (their result
+        decides the next window's context AND which lanes retire —
+        allocator state must not change under an in-flight reader).
+        `synced` as in `_plain_complete`."""
+        K = self.spec_decode_k
+        out_dev = inflight.out
+        if synced:
+            if self.sampling:
+                choices = np.asarray(out_dev[0])
+                accepts = np.asarray(out_dev[1])
+                nxt = None
+            else:
+                nxt = np.asarray(out_dev)
+        else:
+            with self._phase("device_wait"):
+                if self.sampling:
+                    # sync: per-row stop-choices + accept flags
+                    choices = np.asarray(out_dev[0])
+                    accepts = np.asarray(out_dev[1])
+                    nxt = None
+                else:
+                    # sync: [slots, K+1] argmaxes
+                    nxt = np.asarray(out_dev)
+        self._m_decode_seconds.observe(
+            time.perf_counter() - inflight.t_dec)
+        self._trace_span("decode.verify", inflight.t_span, cat="engine",
+                         lanes=len(inflight.runnable), k=K)
+        t_dec = inflight.t_dec
         now = time.perf_counter()
         with self._phase("finish"):
-            for i in runnable:
-                slot = self._slots[i]
+            for i, slot in zip(inflight.runnable, inflight.slots):
                 req = slot.req
-                d = drafts[i]
+                d = inflight.drafts[i]
                 if self.sampling:
                     # rejection-sampling acceptance (computed on
                     # device): accept the longest draft prefix whose
@@ -2839,14 +2971,20 @@ class GenerationEngine:
                         self._finish(slot,
                                      "eos" if done_eos else "length")
                     self._slots[i] = None
-        return len(runnable)
+        return len(inflight.runnable)
 
     def step(self):
         """One scheduler iteration: admit queued requests into free
         lanes, run AT MOST one prefill chunk (chunked mode — long
         prompts never monopolize an iteration), then one batched decode
         step over every decode-phase lane. Returns the number of
-        admissions/chunks/lanes that made progress."""
+        admissions/chunks/lanes that made progress.
+
+        With the async core on (`async_core=True` / PADDLE_SERVE_ASYNC)
+        the same stages run pipelined one step ahead — `_step_async`;
+        off (the default) this is the serial loop, op-for-op."""
+        if self.async_core:
+            return self._step_async()
         with RecordEvent("engine.step"):
             t_wall = time.perf_counter()
             if self.chunked_prefill:
@@ -2858,6 +2996,172 @@ class GenerationEngine:
             self._flush_step_phases(time.perf_counter() - t_wall)
             self._end_of_step_gauges()
             return progressed
+
+    # -- async engine core (dispatch-ahead pipeline) -----------------------
+    def _step_async(self):
+        """One pipelined scheduler iteration — the dispatch-ahead core
+        (ROADMAP item 3). Stage order per call:
+
+        1. COMPLETE step N: `jax.block_until_ready` on the in-flight
+           output the PREVIOUS call dispatched. `device_wait` here is
+           the true residual — every host stage since that dispatch
+           (the previous call's adapter prefetch, the caller's
+           inter-step work, e.g. the fleet's other replicas) already
+           overlapped the device time. The acceptance/sample walks and
+           lane retirement stay on the step thread: their results
+           decide the NEXT window's context, and a retired lane's
+           blocks must not re-enter the allocator while a dispatched
+           step could still write to them.
+        2. SPAWN the drafter helper: every decode lane's next-window
+           proposal runs on a short-lived thread over SNAPSHOTS of the
+           post-walk context — identical inputs to the serial
+           proposal, so drafts (and therefore sampled lanes'
+           acceptance coins) cannot diverge.
+        3. ADMIT + one prefill chunk on the step thread, concurrently
+           with the helper.
+        4. SCHEDULE + DISPATCH step N+1: drafts joined from the
+           helper (lanes the helper missed — just admitted or fresh
+           out of prefill — propose inline, exactly the serial path),
+           dynamic rows ride ONE fused `device_put` tree, and the
+           dispatched step stays in the in-flight slot for the next
+           call.
+        5. PREFETCH the queue head's adapter page: the compiled
+           swap-in dispatch is cheap host-side and the page copy
+           overlaps step N+1 on device, so the NEXT call's admission
+           acquires a resident page.
+
+        `progressed` counts admissions, prefill chunks, and COMPLETED
+        decode lanes — a dispatch is credited only when its result is
+        consumed, so run totals match the serial core and `run()`'s
+        no-progress deadlock check stays sound (an outstanding
+        in-flight step always progresses on the next call)."""
+        with RecordEvent("engine.step"):
+            t_wall = time.perf_counter()
+            progressed = self._complete_inflight()
+            self._spawn_ahead()
+            if self.chunked_prefill:
+                progressed += self._admit_chunked()
+                progressed += self._prefill_step()
+            else:
+                progressed += self._admit()
+            self._next_drafts = self._collect_ahead()
+            self._dispatch_ahead()
+            self._next_drafts = {}
+            self._prefetch_ahead()
+            self._flush_step_phases(time.perf_counter() - t_wall)
+            self._end_of_step_gauges()
+            return progressed
+
+    def _complete_inflight(self):
+        """Retire the dispatched-ahead step, if one is outstanding:
+        block for the device residual, then run the normal complete
+        stage (walks + finish) on the step thread."""
+        inflight = self._inflight
+        if inflight is None:
+            return 0
+        self._inflight = None
+        with self._phase("device_wait"):
+            # the ONLY wait of the pipeline: everything since the
+            # dispatch already ran behind the device step
+            jax.block_until_ready(inflight.out)
+        self.flight.record("async_complete", seq=inflight.seq,
+                           lanes=len(inflight.runnable))
+        if self.spec_decode_k:
+            return self._spec_complete(inflight, synced=True)
+        return self._plain_complete(inflight, synced=True)
+
+    def _dispatch_ahead(self):
+        """Schedule + dispatch the next decode/verify step into the
+        single in-flight slot — no wait; the next `step()` call (or
+        `drain`) completes it."""
+        if self.spec_decode_k:
+            runnable, drafts = self._spec_schedule()
+            if not runnable:
+                return
+            self._inflight = self._spec_dispatch(runnable, drafts)
+        else:
+            runnable = self._plain_schedule()
+            if not runnable:
+                return
+            self._inflight = self._plain_dispatch(runnable)
+        self.flight.record("async_dispatch", seq=self._inflight.seq,
+                           lanes=len(runnable))
+
+    def _spawn_ahead(self):
+        """Launch the drafter helper thread: propose every decode
+        lane's next verify window off the step thread while admissions
+        and the prefill chunk run. Jobs snapshot `generated` (the live
+        list mutates when lanes advance) and run the pure
+        `draft_window` — see its thread-safety contract. The helper's
+        `draft_propose` seconds land on ITS thread-confined PhaseTimer
+        clock, never in the step's host-gap partition."""
+        if not self.spec_decode_k or self.drafter is None:
+            return
+        K = self.spec_decode_k
+        vocab = self.model.config.vocab_size
+        jobs = []
+        for slot in self._slots:
+            if slot is None or slot.prefilling:
+                continue
+            budget = min(
+                K,
+                slot.req.max_new_tokens - len(slot.generated) - 1,
+                self.max_model_len - 1 - slot.feed_pos)
+            if budget > 0:
+                jobs.append((slot, slot.req.prompt,
+                             list(slot.generated), budget))
+        if not jobs:
+            return
+        out = {}
+        phases = self._phases
+        drafter = self.drafter
+
+        def work():
+            for slot, prompt, generated, budget in jobs:
+                with phases.phase("draft_propose"):
+                    out[slot] = draft_window(drafter, prompt,
+                                             generated, budget, vocab)
+
+        t = threading.Thread(target=work, name="paddle-draft-ahead",
+                             daemon=True)
+        t.start()
+        self._ahead = (t, out)
+
+    def _collect_ahead(self):
+        """Join the drafter helper. Only the step thread's residual
+        wait (usually ~zero — admissions ran in between) lands in its
+        own `draft_propose` phase; the proposals themselves were
+        clocked on the helper's thread."""
+        ahead = self._ahead
+        if ahead is None:
+            return {}
+        self._ahead = None
+        t, out = ahead
+        with self._phase("draft_propose"):
+            t.join()
+        return out
+
+    def _prefetch_ahead(self):
+        """Warm the NEXT admission's adapter page behind the step just
+        dispatched: `PagedAdapterPool.prefetch` costs one compiled
+        swap-in dispatch on the host while the page copy overlaps the
+        in-flight step on device, and it never takes a reference or
+        evicts a live page — so the next call's `_acquire_adapter`
+        finds the page resident and pays no transfer in the host
+        gap."""
+        if self.adapter_pool is None:
+            return
+        req = self._peek_request()
+        if req is None or not req.adapter_id \
+                or not self.adapter_pool.registry.has(req.adapter_id):
+            return
+        if self.adapter_pool.page_of(req.adapter_id) is not None:
+            return                     # already resident (warm or live)
+        page = self.adapter_pool.prefetch(req.adapter_id)
+        if page is not None:
+            self.flight.record("adapter_prefetch", req.req_id,
+                               adapter=int(req.adapter_id), page=page)
+            self._update_adapter_gauges()
 
     def _end_of_step_gauges(self):
         self._m_active.set(self.num_active)

@@ -737,7 +737,15 @@ class ServingFleet:
         scheduler iteration on every replica with work, collecting
         finishes as they land. Returns the number of placements /
         engine progress units / finishes — 0 means the fleet cannot
-        currently move."""
+        currently move.
+
+        With async-core replicas the handoff work is the latency
+        hiding ROADMAP item 3 promised: each `eng.step()` returns with
+        a dispatch-ahead decode step still IN FLIGHT, so the second
+        placement pass below (and the leading pass of the NEXT
+        iteration) runs its compiled export/ingest scatters and
+        adoption bookkeeping while every replica's device is busy —
+        not against an idle device as the serial fleet did."""
         progressed = self._flush_handoffs()
         for rid in list(self._replicas):
             rep = self._replicas[rid]
@@ -748,7 +756,49 @@ class ServingFleet:
             if results:
                 progressed += len(results)
                 self._collect(rep, results)
+        if self._pending_handoffs:
+            # lanes vacated by the steps above can seat exported
+            # prefills NOW instead of next iteration (one full fleet
+            # sweep earlier) — overlapped with the in-flight steps
+            # when replicas run the async core
+            progressed += self._flush_handoffs()
+            # still-queued handoffs: warm the adapter page their
+            # adoption will need on the likeliest target replica while
+            # the devices crunch
+            self._prestage_handoffs()
         return progressed
+
+    def _prestage_handoffs(self):
+        """Adapter prefetch for queued handoffs (async latency
+        hiding): for each pending handoff whose tenant carries an
+        adapter, warm that adapter's page on the least-loaded decode
+        replica that could take the placement — the compiled swap-in
+        copy overlaps the replicas' in-flight steps, and the eventual
+        `_place_handoff` adoption acquires a RESIDENT page instead of
+        paying the transfer in the placement path. Best-effort only:
+        no references taken, no placement decisions made here."""
+        staged = set()
+        for h in self._pending_handoffs:
+            info = self._requests.get(h["req_id"])
+            if info is None:
+                continue
+            aid = int(info.get("adapter_id", 0) or 0)
+            if not aid or aid in staged:
+                continue
+            targets = sorted(self._routable("decode"),
+                             key=lambda r: (r.load, r.rid))
+            for rep in targets:
+                pool = rep.engine.adapter_pool
+                if pool is None \
+                        or not pool.registry.has(aid):
+                    continue
+                if pool.page_of(aid) is not None \
+                        or pool.prefetch(aid) is not None:
+                    staged.add(aid)
+                    rep.engine.flight.record(
+                        "adapter_prefetch", h["req_id"], adapter=aid,
+                        page=pool.page_of(aid))
+                    break
 
     @property
     def num_outstanding(self):

@@ -44,7 +44,35 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["NgramDrafter", "GptDrafter"]
+__all__ = ["NgramDrafter", "GptDrafter", "draft_window"]
+
+
+def draft_window(drafter, prompt, generated, budget, vocab):
+    """One lane's proposal for its next verify window, junk-filtered.
+
+    Runs `drafter.propose(prompt, generated, budget)` and keeps the
+    longest prefix of in-vocab tokens, capped at `budget` — the exact
+    filter the engine's serial scheduler applies, factored out so the
+    async core's drafter thread and the serial path share one
+    definition (a single divergence here would break the serial-vs-
+    async token-identity gate for sampled lanes, whose acceptance
+    coins are compared against the DRAFT token at each position).
+
+    Thread-safety contract: both shipped drafters are pure functions
+    of (prompt, generated, budget) — `NgramDrafter` is numpy over a
+    private copy of the context, `GptDrafter` runs eager jax forwards
+    with no mutable state — so this helper may run off the step thread
+    as long as the caller passes a SNAPSHOT of `generated` (the step
+    thread appends to the live list when lanes advance).
+    """
+    draft = []
+    if budget > 0:
+        for t in drafter.propose(prompt, generated, budget):
+            t = int(t)
+            if not 0 <= t < vocab or len(draft) >= budget:
+                break                  # junk proposal: verify nothing
+            draft.append(t)
+    return draft
 
 
 class NgramDrafter:

@@ -178,41 +178,56 @@ class PhaseTimer:
     disjoint and sum to (at most) the step's wall time — the property
     that makes `engine_step_device_fraction` = device_wait / wall a
     real fraction instead of double-counting nested sections.
-    Single-threaded by design (the engine scheduler is host-serial —
-    the very tax this measures); not locked.
+
+    Thread-confined: each thread owns its own stack AND accumulator
+    (the async engine core runs drafter proposals on a helper thread
+    while the step thread is inside its own phases — a phase recorded
+    off the step thread must neither pause the step thread's active
+    phase nor fold its overlapped seconds into the step thread's
+    totals, or phase sums would exceed step wall time and
+    `engine_step_device_fraction` would stop being a fraction).
+    `reset()` and `totals()` operate on the calling thread's clock
+    only; no locks needed because no state is shared.
     """
 
     def __init__(self):
-        self._acc = {}
-        self._stack = []               # [name, slice_start] frames
+        self._tls = threading.local()
+
+    def _state(self):
+        tls = self._tls
+        if not hasattr(tls, "acc"):
+            tls.acc = {}
+            tls.stack = []             # [name, slice_start] frames
+        return tls.acc, tls.stack
 
     def reset(self):
-        out = self._acc
-        self._acc = {}
-        self._stack.clear()
-        return out
+        acc, stack = self._state()
+        self._tls.acc = {}
+        stack.clear()
+        return acc
 
     @contextmanager
     def phase(self, name):
+        acc, stack = self._state()
         now = time.perf_counter()
-        if self._stack:                # pause the enclosing phase
-            outer = self._stack[-1]
-            self._acc[outer[0]] = self._acc.get(outer[0], 0.0) \
-                + now - outer[1]
-        self._stack.append([name, now])
+        if stack:                      # pause the enclosing phase
+            outer = stack[-1]
+            acc[outer[0]] = acc.get(outer[0], 0.0) + now - outer[1]
+        stack.append([name, now])
         try:
             yield
         finally:
-            frame = self._stack.pop()
+            acc, stack = self._state()
+            frame = stack.pop()
             now = time.perf_counter()
-            self._acc[frame[0]] = self._acc.get(frame[0], 0.0) \
-                + now - frame[1]
-            if self._stack:            # resume the enclosing phase
-                self._stack[-1][1] = now
+            acc[frame[0]] = acc.get(frame[0], 0.0) + now - frame[1]
+            if stack:                  # resume the enclosing phase
+                stack[-1][1] = now
 
     def totals(self):
-        """phase -> accumulated exclusive seconds since last reset."""
-        return dict(self._acc)
+        """phase -> accumulated exclusive seconds since last reset,
+        for the CALLING thread's clock."""
+        return dict(self._state()[0])
 
 
 class FlightRecorder:

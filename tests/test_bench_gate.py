@@ -169,7 +169,7 @@ def test_repo_resnet_row_carries_decided_floor():
 
 def test_pending_smoke_flags_unadopted_opbench_rows():
     """--pending smoke (ISSUE 4 satellite): the suite rows added by
-    PRs 1-17 stay VISIBLY pending until a TPU `bench_ops.py --save`
+    PRs 1-18 stay VISIBLY pending until a TPU `bench_ops.py --save`
     refresh adopts them — the gate must keep saying so, loudly."""
     res = _run(["--pending", os.path.join(REPO, "OPBENCH.json")])
     assert res.returncode == 0, res.stdout + res.stderr  # report-only
@@ -184,7 +184,7 @@ def test_pending_smoke_flags_unadopted_opbench_rows():
                 "gpt_engine_multitenant_lora", "gpt_engine_sampling",
                 "conv_fused_sweep", "resnet50_fused_block",
                 "conv_fused_bwd_sweep", "resnet50_fused_block_train",
-                "gpt_engine_host_gap"):
+                "gpt_engine_host_gap", "gpt_engine_async_overlap"):
         assert f"PENDING: {row}" in res.stdout, res.stdout
     assert "pending row(s) not gated" in res.stdout
     # --strict turns the report into a failure

@@ -1,0 +1,585 @@
+"""Async engine core (ISSUE 18): the dispatch-ahead decode pipeline.
+
+The contract under test is brutal on purpose: the async core is a
+SCHEDULING refactor, not a numerics change —
+
+- token IDENTITY serial vs async across the whole serving matrix
+  ({dense, pallas} x K in {0, 4} x mp in {1, 2} x kv in {fp, int8}),
+  chunked cold + warm and legacy bucketed prefill, with mid-run
+  admissions, saturation shedding, and adapter-pool evictions in the
+  mix.  Sampled lanes hold too: the acceptance coin at each verify
+  position is compared against p(draft token), so identical tokens
+  REQUIRE identical drafts — the helper-thread proposals must equal
+  the serial ones bit-for-bit (`_m_spec_ok/_m_spec_rej` equality is
+  asserted as the direct witness).
+- the pipeline DRAINS: an in-flight dispatched step outstanding when
+  EOS lands / drain() is called completes on the step thread, and the
+  block/adapter-page leak audits stay green.
+- `decode_traces == 1` per config and steady-state `expect_traces(0)`
+  — dispatch-ahead reuses the exact compiled programs.
+- `PADDLE_SERVE_ASYNC` wins over the ctor arg; async off (the
+  default) leaves the engine on the serial path with no in-flight
+  machinery engaged.
+- the flight recorder shows the pipeline actually pipelining:
+  `async_dispatch(seq)` strictly precedes `async_complete(seq)` and
+  completes interleave one-ahead, never deeper.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.jit as jit
+from paddle_tpu.adapters import AdapterRegistry
+from paddle_tpu.inference import GenerationEngine, ServingFleet
+from paddle_tpu.inference import speculative
+from paddle_tpu.inference.sampling import SamplingParams
+
+VOCAB = 64
+
+
+def _model(seed=0):
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(seed)
+    cfg = GPTConfig.tiny(vocab=VOCAB, hidden=32, layers=2, heads=4,
+                         seq=64)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _model()
+
+
+@pytest.fixture(autouse=True)
+def _no_env_overrides(monkeypatch):
+    for var in ("PADDLE_SERVE_ASYNC", "PADDLE_SPEC_DECODE_K",
+                "PADDLE_PAGED_ATTENTION_BACKEND",
+                "PADDLE_SERVE_KV_DTYPE", "PADDLE_SERVE_MP"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def _trace(rng, n=4):
+    """Mixed lengths + motif-tiled prompts (so the NgramDrafter
+    actually matches and the accept walk sees non-empty windows) + a
+    hot shared prefix."""
+    motif = rng.randint(0, VOCAB, 3).astype(np.int32)
+    reqs = [(rng.randint(0, VOCAB, rng.randint(2, 13)).astype(np.int32),
+             int(rng.randint(2, 7))) for _ in range(n)]
+    reqs += [(np.tile(motif, 5).astype(np.int32), 6),
+             (np.tile(motif, 3).astype(np.int32), 8)]
+    shared = rng.randint(0, VOCAB, 8).astype(np.int32)
+    reqs += [(np.concatenate([shared, rng.randint(0, VOCAB, 3)])
+              .astype(np.int32), 4),
+             (shared.copy(), 4)]
+    return reqs
+
+
+def _run_trace(eng, reqs, midrun=True):
+    ids = [eng.add_request(p, n) for p, n in reqs[:len(reqs) // 2]]
+    if midrun:
+        for _ in range(2):
+            eng.step()                 # admissions land mid-pipeline
+    ids += [eng.add_request(p, n) for p, n in reqs[len(reqs) // 2:]]
+    out = eng.run()
+    return [list(map(int, out[rid])) for rid in ids]
+
+
+def _spec_counters(eng):
+    return (int(eng._m_spec_ok.value), int(eng._m_spec_rej.value))
+
+
+def _assert_async_matrix_cell(model, backend, K, mp=None, kv=None,
+                              bucketed=True):
+    """One (backend, K, mp, kv_dtype) cell: the same mixed trace
+    served serial then async over (a) chunked cold, (b) same engine
+    warm, (c) legacy bucketed — token lists identical per mode, ONE
+    decode trace each, and at K>0 identical draft-acceptance counters
+    (the direct witness that helper-thread drafts equal serial
+    drafts)."""
+    rng = np.random.RandomState(11)
+    reqs = _trace(rng)
+
+    def serve(async_core):
+        quant = dict(kv_dtype=kv, weight_dtype=kv) if kv else {}
+        def mk(**kw):
+            return GenerationEngine(model, num_slots=3, block_size=4,
+                                    num_blocks=64, spec_decode_k=K,
+                                    attention_backend=backend,
+                                    mp_degree=mp, async_core=async_core,
+                                    **quant, **kw)
+
+        eng = mk(prefill_chunk=8)
+        out = [_run_trace(eng, reqs),
+               _run_trace(eng, reqs, midrun=False)]   # warm cache
+        engines = [eng]
+        if bucketed:
+            eng_b = mk(prefill_buckets=(16, 64))
+            out.append(_run_trace(eng_b, reqs))
+            engines.append(eng_b)
+        for e in engines:
+            assert e.async_core == async_core
+            assert e.decode_traces == 1, \
+                (f"{backend} K={K} mp={mp} kv={kv} "
+                 f"async={async_core}: decode retraced")
+        return out, eng
+
+    serial, eng_s = serve(False)
+    amode, eng_a = serve(True)
+    assert amode == serial, \
+        f"{backend} K={K} mp={mp} kv={kv}: async diverged from serial"
+    if K:
+        assert _spec_counters(eng_a) == _spec_counters(eng_s), \
+            "helper-thread drafts diverged from serial proposals"
+        assert sum(_spec_counters(eng_s)) > 0, \
+            "trace never exercised the drafter — weak test"
+    # the async engine retired every dispatched step before returning
+    assert eng_a._inflight is None and eng_a._ahead is None
+
+
+# ---------------------------------------------------------------------------
+# tentpole: serial-vs-async token identity
+# ---------------------------------------------------------------------------
+
+# The 1-core CI box can't fit the whole suite in the tier-1 window,
+# so tier-1 carries ONE identity cell — dense K=4, the cell that
+# exercises the helper-thread drafter AND the pipeline at once — and
+# the slow tier carries the rest (the test_engine_sharded precedent).
+@pytest.mark.parametrize(
+    "K", [pytest.param(0, marks=pytest.mark.slow), 4])
+def test_async_token_identity_dense(model, K):
+    """Tier-1 cut of THE acceptance gate: (dense, K, mp=1, fp) over
+    chunked cold + warm + bucketed with mid-run admissions."""
+    _assert_async_matrix_cell(model, "dense", K)
+
+
+@pytest.mark.slow
+def test_async_token_identity_pallas_spec(model):
+    """Tier-1 lean probe of the (pallas, K=4) cell — the fused verify
+    kernel under the dispatch-ahead pipeline (chunked legs only; the
+    slow full matrix adds bucketed + mp + int8)."""
+    _assert_async_matrix_cell(model, "pallas", 4, bucketed=False)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv", [None, "int8"])
+@pytest.mark.parametrize("mp", [None, 2])
+@pytest.mark.parametrize("backend,K", [("dense", 0), ("dense", 4),
+                                       ("pallas", 0), ("pallas", 4)])
+def test_async_token_identity_full_matrix(model, backend, K, mp, kv):
+    """The full {backend} x K x mp x kv_dtype identity matrix the
+    ISSUE gates on (slow-marked; tier-1 carries the three lean cells
+    above — the test_engine_sharded precedent)."""
+    _assert_async_matrix_cell(model, backend, K, mp=mp, kv=kv)
+
+
+@pytest.mark.slow
+def test_async_sampled_lanes_identical(model):
+    """Sampled lanes are where draft identity has teeth: the
+    acceptance coin compares against p(draft token), so ANY
+    helper-thread draft divergence shows up as a different token
+    stream. Mixed greedy + sampled lanes, serial vs async."""
+    rng = np.random.RandomState(7)
+    reqs = _trace(rng)
+
+    def serve(async_core):
+        eng = GenerationEngine(model, num_slots=3, block_size=4,
+                               num_blocks=64, prefill_chunk=8,
+                               spec_decode_k=4, sampling=True,
+                               async_core=async_core)
+        ids = []
+        for i, (p, n) in enumerate(reqs):
+            sp = SamplingParams(temperature=0.9, top_k=8,
+                                seed=100 + i) if i % 2 else None
+            ids.append(eng.add_request(p, n, sampling_params=sp))
+        out = eng.run()
+        return [list(map(int, out[rid])) for rid in ids], eng
+
+    serial, eng_s = serve(False)
+    amode, eng_a = serve(True)
+    assert amode == serial
+    assert _spec_counters(eng_a) == _spec_counters(eng_s)
+
+
+# ---------------------------------------------------------------------------
+# draft_window: the ONE filter both the serial scheduler and the
+# async drafter thread run — pure-function contract (no engine, no
+# jit; a divergence here breaks sampled-lane token identity, so the
+# edge cases get direct coverage)
+# ---------------------------------------------------------------------------
+
+class _ListDrafter:
+    """Stub drafter replaying a fixed proposal regardless of input."""
+
+    def __init__(self, tokens):
+        self.tokens = list(tokens)
+
+    def propose(self, prompt, generated, budget):
+        return list(self.tokens)
+
+
+@pytest.mark.parametrize("proposal,budget,vocab,want", [
+    ([3, 5, 7], 3, 64, [3, 5, 7]),        # in-vocab, exact budget
+    ([3, 5, 7, 9], 2, 64, [3, 5]),        # over-proposal capped
+    ([3, 64, 7], 3, 64, [3]),             # vocab edge truncates...
+    ([3, -1, 7], 3, 64, [3]),             # ...as does a negative id
+    ([64, 3, 5], 3, 64, []),              # junk head: verify nothing
+    ([3, 5], 0, 64, []),                  # exhausted budget: no call
+    ([3, 5], -2, 64, []),                 # clamped budget stays empty
+    ([], 4, 64, []),                      # drafter declined
+])
+def test_draft_window_junk_filter_and_budget(proposal, budget, vocab,
+                                             want):
+    got = speculative.draft_window(_ListDrafter(proposal), [1, 2],
+                                   [0], budget, vocab)
+    assert got == want
+
+
+def test_draft_window_numpy_scalars_coerced():
+    """Drafters may return numpy ints; the window must hand the
+    engine plain Python ints (they're compared + device_put later)."""
+    got = speculative.draft_window(
+        _ListDrafter(np.array([3, 5], dtype=np.int32)), [1], [], 2, 64)
+    assert got == [3, 5] and all(type(t) is int for t in got)
+
+
+def test_draft_window_snapshot_equals_live_context():
+    """The async core hands the helper thread a SNAPSHOT of
+    slot.generated; the ngram drafter must propose identically from
+    the copy (purity — the thread-safety contract in the docstring)."""
+    rng = np.random.RandomState(3)
+    motif = rng.randint(0, 64, 4).tolist()
+    prompt = np.array(motif * 3, dtype=np.int32)
+    live = list(motif) + [7]
+    drafter = speculative.NgramDrafter()
+    a = speculative.draft_window(drafter, prompt, list(live), 4, 64)
+    b = speculative.draft_window(drafter, prompt, live, 4, 64)
+    assert a == b
+    assert live == list(motif) + [7]      # context never mutated
+
+
+# ---------------------------------------------------------------------------
+# satellite: knob resolution + serial path untouched
+# ---------------------------------------------------------------------------
+
+def test_async_knob_default_off_and_ctor(model):
+    assert GenerationEngine(model, num_slots=2, block_size=4,
+                            num_blocks=32).async_core is False
+    assert GenerationEngine(model, num_slots=2, block_size=4,
+                            num_blocks=32,
+                            async_core=True).async_core is True
+
+
+def test_async_env_knob_wins_over_ctor(model, monkeypatch):
+    mk = lambda **kw: GenerationEngine(model, num_slots=2,
+                                       block_size=4, num_blocks=32,
+                                       **kw)
+    monkeypatch.setenv("PADDLE_SERVE_ASYNC", "1")
+    assert mk(async_core=False).async_core is True
+    monkeypatch.setenv("PADDLE_SERVE_ASYNC", "off")
+    assert mk(async_core=True).async_core is False
+    monkeypatch.setenv("PADDLE_SERVE_ASYNC", "")   # '' means unset
+    assert mk(async_core=True).async_core is True
+    monkeypatch.setenv("PADDLE_SERVE_ASYNC", "maybe")
+    with pytest.raises(ValueError, match="PADDLE_SERVE_ASYNC"):
+        mk()
+
+
+def test_async_off_engages_no_pipeline_state(model):
+    """The serial default never touches the in-flight machinery: no
+    dispatched-ahead slot, no helper thread, no async flight events —
+    the op-for-op guarantee has an observable witness."""
+    rng = np.random.RandomState(3)
+    eng = GenerationEngine(model, num_slots=2, block_size=4,
+                           num_blocks=32, prefill_chunk=8,
+                           spec_decode_k=4)
+    eng.add_request(rng.randint(0, VOCAB, 6).astype(np.int32), 5)
+    eng.run()
+    assert eng._inflight is None and eng._ahead is None
+    events = {e["event"] for e in eng.flight.dump()}
+    assert not (events & {"async_dispatch", "async_complete",
+                          "adapter_prefetch"})
+
+
+# ---------------------------------------------------------------------------
+# satellite: pipeline drain — EOS / shed / drain() with a step in flight
+# ---------------------------------------------------------------------------
+
+def test_async_drain_completes_inflight_step(model):
+    """drain() called while a dispatched-ahead step is outstanding:
+    the in-flight step must complete (not leak device work or
+    blocks), results must match the serial engine, and both leak
+    audits must pass."""
+    rng = np.random.RandomState(9)
+    reqs = _trace(rng)
+
+    def serve(async_core):
+        eng = GenerationEngine(model, num_slots=3, block_size=4,
+                               num_blocks=64, prefill_chunk=8,
+                               spec_decode_k=4, async_core=async_core)
+        ids = [eng.add_request(p, n) for p, n in reqs]
+        # step until a dispatched step is actually in flight, then
+        # drain with it outstanding
+        for _ in range(16):
+            eng.step()
+            if async_core and eng._inflight is not None:
+                break
+        if async_core:
+            assert eng._inflight is not None, \
+                "trace never left a step in flight — weak test"
+        out = eng.drain()               # audits blocks + raises on leak
+        return [list(map(int, out[rid])) for rid in ids], eng
+
+    serial, _ = serve(False)
+    amode, eng = serve(True)
+    assert amode == serial
+    assert eng._inflight is None and eng._ahead is None
+
+
+@pytest.mark.slow
+def test_async_eos_mid_pipeline(model):
+    """An EOS accepted while the pipeline is warm truncates exactly
+    like the serial engine — the in-flight step covering the retired
+    lane completes and the lane's blocks come back."""
+    rng = np.random.RandomState(5)
+    motif = rng.randint(0, VOCAB, 3).astype(np.int32)
+    reqs = [(np.tile(motif, 4).astype(np.int32), 12),
+            (rng.randint(0, VOCAB, 7).astype(np.int32), 12)]
+
+    def serve(async_core, eos):
+        eng = GenerationEngine(model, num_slots=2, block_size=4,
+                               num_blocks=64, prefill_chunk=8,
+                               spec_decode_k=4, async_core=async_core)
+        ids = [eng.add_request(p, n, eos_token_id=eos)
+               for p, n in reqs]
+        out = eng.drain()
+        return [list(map(int, out[rid])) for rid in ids]
+
+    base = serve(False, None)
+    # pick an eos the streams actually emit -> mid-run truncation
+    eos = int(base[0][len(reqs[0][0]) + 1])
+    serial = serve(False, eos)
+    amode = serve(True, eos)
+    assert amode == serial
+    assert any(len(a) < len(b) for a, b in zip(serial, base)), \
+        "eos never truncated a stream — weak test"
+
+
+@pytest.mark.slow
+def test_async_shed_midrun_identical(model):
+    """Saturation shedding under the async core: same losers (None
+    results), same survivors' tokens as serial."""
+    rng = np.random.RandomState(13)
+    reqs = [(rng.randint(0, VOCAB, rng.randint(3, 10))
+             .astype(np.int32), 4) for _ in range(8)]
+
+    def serve(async_core):
+        eng = GenerationEngine(model, num_slots=2, block_size=4,
+                               num_blocks=64, prefill_chunk=8,
+                               max_queue=2, async_core=async_core)
+        ids = [eng.add_request(p, n, priority="batch")
+               for p, n in reqs]
+        out = eng.run()
+        shed = sum(out[rid] is None for rid in ids)
+        return [None if out[rid] is None else
+                list(map(int, out[rid])) for rid in ids], shed
+
+    serial, shed_s = serve(False)
+    amode, shed_a = serve(True)
+    assert amode == serial
+    assert shed_a == shed_s > 0, "queue never saturated — weak test"
+
+
+# ---------------------------------------------------------------------------
+# satellite: compiled-program identity + steady state
+# ---------------------------------------------------------------------------
+
+def test_async_steady_state_retraces_nothing(model):
+    """A warmed async engine serves new work under
+    `expect_traces(0)` on both compiled steps — dispatch-ahead feeds
+    the EXACT programs the serial core compiled."""
+    rng = np.random.RandomState(2)
+    eng = GenerationEngine(model, num_slots=3, block_size=4,
+                           num_blocks=64, prefill_chunk=8,
+                           spec_decode_k=4, async_core=True)
+    _run_trace(eng, _trace(rng))
+    assert eng.decode_traces == 1 and eng.prefill_traces == 1
+    with jit.expect_traces(eng._decode_pure, 0), \
+            jit.expect_traces(eng._prefill_pure, 0):
+        eng.add_request(rng.randint(0, VOCAB, 9).astype(np.int32), 5)
+        eng.run()
+
+
+# ---------------------------------------------------------------------------
+# satellite: the flight recorder shows the pipeline pipelining
+# ---------------------------------------------------------------------------
+
+def test_async_flight_recorder_interleave(model):
+    """The black box proves the dispatch-ahead shape: per sequence
+    number, `async_dispatch(s)` strictly precedes `async_complete(s)`;
+    the pipe never runs deeper than ONE in-flight step (dispatch s+1
+    only after complete s); every dispatch is eventually completed."""
+    rng = np.random.RandomState(4)
+    eng = GenerationEngine(model, num_slots=3, block_size=4,
+                           num_blocks=64, prefill_chunk=8,
+                           spec_decode_k=4, async_core=True,
+                           flight_capacity=4096)
+    _run_trace(eng, _trace(rng))
+    evs = [(e["event"], e["seq"]) for e in eng.flight.dump()
+           if e["event"] in ("async_dispatch", "async_complete")]
+    assert evs, "no pipeline events recorded"
+    outstanding = None
+    seen = 0
+    for event, seq in evs:
+        if event == "async_dispatch":
+            assert outstanding is None, \
+                f"dispatch {seq} while {outstanding} in flight"
+            assert seq == seen + 1, f"dispatch seq skipped: {evs}"
+            outstanding, seen = seq, seq
+        else:
+            assert outstanding == seq, \
+                f"complete {seq} without its dispatch"
+            outstanding = None
+    assert outstanding is None, "a dispatched step was never completed"
+    assert seen > 2, "trace too short to exercise the pipeline"
+
+
+# ---------------------------------------------------------------------------
+# satellite: adapter prefetch rides the pipeline
+# ---------------------------------------------------------------------------
+
+def _strong_registry(cfg, ranks=(2, 3), seed=7, scale=0.3, group=None):
+    rng = np.random.RandomState(seed)
+    reg = AdapterRegistry(cfg, max_rank=4)
+    H, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    for aid, r in enumerate(ranks, start=1):
+        w = {}
+        for site, (i_d, o_d) in (("qkv", (H, 3 * H)), ("out", (H, H)),
+                                 ("fc1", (H, I)), ("fc2", (I, H))):
+            w[site] = [(rng.randn(r, i_d).astype(np.float32) * scale,
+                        rng.randn(o_d, r).astype(np.float32) * scale)
+                       for _ in range(L)]
+        reg.register(aid, w, scaling=0.5, group=group)
+    return reg
+
+
+@pytest.mark.slow
+def test_async_adapter_prefetch_and_evictions(model):
+    """Multi-tenant trace under pool pressure (3 hot adapters + base
+    over 1-2 usable pages): async serves token-identically to serial
+    while
+    `adapter_prefetch` events land in the flight recorder, evictions
+    still happen mid-run, and the drain audit stays green."""
+    registry = _strong_registry(model.config, ranks=(2, 3, 2))
+    rng = np.random.RandomState(11)
+    reqs = []
+    for aid in (1, 2, 0, 3, 0, 1, 3, 2):
+        reqs.append((rng.randint(0, VOCAB, rng.randint(2, 12))
+                     .astype(np.int32), int(rng.randint(2, 6)), aid))
+
+    def serve(async_core, pages):
+        eng = GenerationEngine(model, num_slots=2, block_size=4,
+                               num_blocks=64, prefill_chunk=8,
+                               adapters=registry,
+                               adapter_pool_pages=pages,
+                               async_core=async_core)
+        ids = [eng.add_request(p, n, adapter_id=a)
+               for p, n, a in reqs]
+        out = eng.drain()
+        return [list(map(int, out[rid])) for rid in ids], eng
+
+    # pressure leg: ONE usable page -> the tenants thrash it, and the
+    # prefetcher must never steal it from a live lane
+    serial, eng_s = serve(False, pages=2)
+    amode, eng_a = serve(True, pages=2)
+    assert amode == serial
+    assert eng_a.adapter_pool.evictions > 0, \
+        "pool never thrashed — weak test"
+    # headroom leg: with a spare page the pipeline warms the queue
+    # head's adapter behind the dispatched step
+    serial, _ = serve(False, pages=3)
+    amode, eng_a = serve(True, pages=3)
+    assert amode == serial
+    prefetches = [e for e in eng_a.flight.dump()
+                  if e["event"] == "adapter_prefetch"]
+    assert prefetches, "async core never prefetched an adapter page"
+    # prefetch is an optimization, not an accounting channel: pages
+    # still audit clean (drain() above already asserted leak_check)
+    assert eng_a.adapter_pool.leak_check() == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: the gpt_engine_async_overlap bench row
+# ---------------------------------------------------------------------------
+
+def test_suite_rows_carry_async_overlap_row():
+    import bench_ops
+
+    assert "gpt_engine_async_overlap" in bench_ops.SUITE_ROWS
+
+
+@pytest.mark.slow
+def test_async_overlap_bench_runner_tiny(monkeypatch):
+    """The `gpt_engine_async_overlap` runner end-to-end on a tiny
+    config — its in-runner gates ARE the acceptance criteria: per-rep
+    token identity, async overlappable host gap
+    (schedule+draft_propose+adapter_swap) strictly below serial's,
+    async device fraction no lower. Here we only re-check the record
+    shape; the runner already threw if any gate failed."""
+    from paddle_tpu.models import GPTConfig
+
+    import bench_ops
+
+    monkeypatch.delenv("PADDLE_SERVE_TRACING", raising=False)
+    # hidden=256/layers=3 keeps the step device-bound even on the CPU
+    # runner: the device-fraction gate (async >= serial) only holds
+    # structurally when there IS device time left to hide host work
+    # behind — a host-bound toy model lets the async core drive the
+    # device_wait residual toward zero, which is the pipeline working,
+    # not a regression.
+    cfg = GPTConfig.tiny(vocab=VOCAB, hidden=256, layers=3, heads=4,
+                         seq=128)
+    rec = bench_ops._engine_async_overlap_case(
+        model_cfg=cfg, num_requests=12, block_size=8, max_new=6)()
+    assert "ms" in rec and rec["ms"] > 0
+    for mode in ("serial", "async"):
+        phases = rec[mode]["phase_ms_per_step_warm"]
+        assert "dispatch" in phases and "adapter_swap" in phases
+        assert 0.0 <= rec[mode]["device_fraction_warm"] <= 1.0
+    assert rec["async"]["host_overlap_gap_ms"] \
+        < rec["serial"]["host_overlap_gap_ms"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: fleet replicas run the async core via the env knob
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_async_replicas_token_exact(model, monkeypatch):
+    """A disaggregated fleet with every replica on the async core
+    (via PADDLE_SERVE_ASYNC — the fleet builds its own engines) stays
+    token-exact vs the serial bare engine, and the prestaged handoff
+    flush still drains every parked prefill."""
+    rng = np.random.RandomState(6)
+    trace = [(rng.randint(0, VOCAB, int(rng.randint(3, 30))), 5)
+             for _ in range(6)]
+
+    def eng_serve():
+        eng = GenerationEngine(model, num_slots=4, block_size=8)
+        ids = [eng.add_request(p, max_new_tokens=n) for p, n in trace]
+        out = eng.run()
+        return {i: list(map(int, out[i])) for i in ids}
+
+    ref = eng_serve()
+    monkeypatch.setenv("PADDLE_SERVE_ASYNC", "1")
+    fleet = ServingFleet(model, num_slots=4, block_size=8,
+                         num_replicas=1, num_prefill_replicas=1)
+    ids = [fleet.add_request(p, max_new_tokens=n) for p, n in trace]
+    out = fleet.run()
+    assert {i: list(map(int, out[i])) for i in ids} == ref
+    for rep in fleet._replicas.values():
+        assert rep.engine.async_core is True

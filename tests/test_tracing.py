@@ -110,6 +110,49 @@ def test_phase_timer_reentrant_same_name():
     assert pt.totals()["a"] >= 0.006
 
 
+def test_phase_timer_thread_confined_clocks():
+    """ISSUE 18 regression: the async core's drafter helper runs its
+    `draft_propose` phases on ANOTHER thread while the step thread
+    sits in its own phase. Each thread owns its whole clock — stack
+    AND accumulator — so an off-thread phase must neither pause the
+    step thread's active phase nor leak seconds into its totals (the
+    step thread's phase totals must keep partitioning ITS wall
+    time)."""
+    import threading
+
+    pt = PhaseTimer()
+    helper_done = threading.Event()
+    helper_tot = {}
+
+    def helper():
+        with pt.phase("draft_propose"):
+            time.sleep(0.03)
+        helper_tot.update(pt.totals())
+        helper_done.set()
+
+    t0 = time.perf_counter()
+    with pt.phase("dispatch"):
+        th = threading.Thread(target=helper)
+        th.start()
+        helper_done.wait()
+        th.join()
+    wall = time.perf_counter() - t0
+    # step thread: ONLY its own phase, covering its full wall — the
+    # helper's concurrent phase neither paused nor shortened it
+    tot = pt.totals()
+    assert set(tot) == {"dispatch"}
+    assert tot["dispatch"] >= 0.03
+    assert tot["dispatch"] <= wall + 0.005
+    # helper thread: its seconds landed on ITS clock only
+    assert set(helper_tot) == {"draft_propose"}
+    assert helper_tot["draft_propose"] >= 0.03
+    # reset is per-thread too: clearing the step thread's clock is
+    # what `_flush_step_phases` does between steps — the helper's
+    # clock was never part of the step partition
+    assert pt.reset() == tot
+    assert pt.totals() == {}
+
+
 def test_trace_recorder_ring_bound_and_drops():
     tr = TraceRecorder(capacity=4)
     for i in range(10):

@@ -32,6 +32,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..jit import introspect
 from .registry import NULL_ADAPTER_ID, AdapterRegistry
 
 __all__ = ["PagedAdapterPool", "adapter_pool_spec"]
@@ -75,6 +76,14 @@ class PagedAdapterPool:
     pool at `1 + num_slots` so a full batch of distinct tenants never
     stalls; smaller pools trade HBM for swap-in traffic and ride the
     stall/retry path under pressure."""
+
+    #: Page-recycling surface declared in introspect (the
+    #: ENGINE_STEP_DONATION pattern): tpu-race TPU203 orders calls to
+    #: these against the engine's dispatch/complete effects — a
+    #: release between them can hand a page to a new tenant while a
+    #: dispatched step still reads the old weights.
+    RACE_RELEASE_METHODS = \
+        introspect.ALLOCATOR_RELEASE_EFFECTS["PagedAdapterPool"]
 
     def __init__(self, registry, num_pages=None, dtype=None, mesh=None,
                  mp_axis="mp", donate=None):

@@ -13,8 +13,11 @@ import re
 from dataclasses import dataclass, field
 
 # `# tpu-lint: disable=TPU001` or `disable=TPU001,TPU005` — suppresses
-# those rules on the SAME physical line.
-_SUPPRESS_RE = re.compile(r"#\s*tpu-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+# those rules on the SAME physical line. Each analyzer tier gets its
+# own tag (tpu-lint / tpu-race), so suppressing one tier never mutes
+# another's rule on the same line.
+_SUPPRESS_TEMPLATE = r"#\s*{tag}:\s*disable=([A-Za-z0-9_,\s]+)"
+_SUPPRESS_RE = re.compile(_SUPPRESS_TEMPLATE.format(tag="tpu-lint"))
 
 
 @dataclass
@@ -60,11 +63,13 @@ def assign_ids(findings):
     return findings
 
 
-def parse_suppressions(src):
+def parse_suppressions(src, tag="tpu-lint"):
     """line (1-based) -> set of rule names suppressed on that line."""
+    pattern = _SUPPRESS_RE if tag == "tpu-lint" \
+        else re.compile(_SUPPRESS_TEMPLATE.format(tag=re.escape(tag)))
     out = {}
     for n, text in enumerate(src.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(text)
+        m = pattern.search(text)
         if m:
             out[n] = {r.strip() for r in m.group(1).split(",") if r.strip()}
     return out

@@ -1,0 +1,47 @@
+"""tpu-race — static thread-safety & allocator-lifetime analysis.
+
+The third analysis tier (TPU2xx): tpu-lint (`paddle_tpu.analysis`,
+AST trace-safety) and tpu-verify (`analysis.trace`, jaxpr contracts)
+check the traced programs; this package checks the host-side
+concurrency AROUND them — lock discipline over shared mutable state,
+thread-escape of helper callables, and the dispatch/complete/release
+ordering that keeps the async engine core's allocators zombie-free
+(DESIGN_DECISIONS r21/r22). `analyze_paths` is the in-process API the
+tier-1 gate uses; `tools/tpu_race.py` is the CLI.
+
+LAZY package init (PEP 562), like the sibling tiers: nothing here
+loads until analysis actually runs, and importing it never
+initializes a JAX backend (the model reads only
+`paddle_tpu.jit.introspect`, pure metadata).
+"""
+from __future__ import annotations
+
+_EXPORTS = {
+    "core": ("analyze_file", "analyze_paths", "collect_files",
+             "Finding", "Result", "RACE_RULES", "all_race_rule_ids",
+             "load_baseline", "apply_baseline", "write_baseline",
+             "BaselineError", "RaceModuleAnalysis", "SUPPRESS_TAG",
+             "_REPO_ROOT"),
+    "cli": ("main", "DEFAULT_BASELINE"),
+}
+
+__all__ = sorted(n for names in _EXPORTS.values() for n in names
+                 if not n.startswith("_"))
+
+_WHENCE = {name: mod for mod, names in _EXPORTS.items()
+           for name in names}
+
+
+def __getattr__(name):
+    mod = _WHENCE.get(name)
+    if mod is not None:
+        import importlib
+
+        return getattr(
+            importlib.import_module(f".{mod}", __name__), name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_WHENCE))

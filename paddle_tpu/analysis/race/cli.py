@@ -1,0 +1,137 @@
+"""tpu-race CLI implementation (thin wrapper lives in
+tools/tpu_race.py).
+
+Exit codes: 0 clean (against baseline), 1 findings, 2 usage/baseline
+error — the tpu-lint convention.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (BaselineError, _REPO_ROOT, all_race_rule_ids,
+                   analyze_paths, load_baseline, write_baseline)
+from .rules import RACE_RULES
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "tpu_race_baseline.json")
+
+
+def _print_stats(res, out):
+    counts = res.per_rule_counts()
+    suppressed = sum(1 for f in res.findings if f.suppressed)
+    baselined = sum(1 for f in res.findings if f.baselined)
+    print("-- tpu-race stats ------------------------------------",
+          file=out)
+    print(f"files analyzed: {len(res.files)}", file=out)
+    if res.parse_errors:
+        print(f"UNPARSEABLE files: {len(res.parse_errors)} "
+              "(reported as TPU200 findings, not skipped):", file=out)
+        for path, msg in res.parse_errors:
+            print(f"  {path}: {msg}", file=out)
+    else:
+        print("unparseable files: 0", file=out)
+    for rule in all_race_rule_ids():
+        name = RACE_RULES[rule][0]
+        print(f"{rule} {name:<26} {counts.get(rule, 0)}", file=out)
+    print(f"suppressed inline: {suppressed}   baselined: {baselined}",
+          file=out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpu_race",
+        description="static thread-safety & allocator-lifetime "
+                    "analyzer")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: paddle_tpu, "
+                         "bench*.py, tools)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON ('none' disables; default: "
+                         "tools/tpu_race_baseline.json when present)")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current new findings as a baseline "
+                         "skeleton (justifications left empty on "
+                         "purpose) and exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-rule finding counts and "
+                         "analyzed/unparseable file totals")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_race_rule_ids():
+            name, desc, _ = RACE_RULES[rule]
+            print(f"{rule}  {name:<26} {desc}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        import glob
+
+        paths = ([os.path.join(_REPO_ROOT, "paddle_tpu")]
+                 + sorted(glob.glob(os.path.join(_REPO_ROOT,
+                                                 "bench*.py")))
+                 + [os.path.join(_REPO_ROOT, "tools")])
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpu_race: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    baseline = {}
+    if args.baseline != "none" and not args.write_baseline:
+        bpath = args.baseline or (
+            DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE)
+            else None)
+        if args.baseline and not os.path.exists(args.baseline):
+            print(f"tpu_race: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+        if bpath:
+            try:
+                baseline = load_baseline(bpath)
+            except (BaselineError, json.JSONDecodeError) as e:
+                print(f"tpu_race: bad baseline {bpath}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    res = analyze_paths(paths, baseline=baseline)
+
+    if args.write_baseline:
+        n = write_baseline(args.write_baseline, res.new_findings())
+        print(f"wrote {n} entries to {args.write_baseline} — add a "
+              "justification to each (the loader rejects empty ones)")
+        return 0
+
+    new = res.new_findings()
+    if args.format == "json":
+        doc = {
+            "findings": [f.to_dict() for f in new],
+            "suppressed": sum(1 for f in res.findings if f.suppressed),
+            "baselined": sum(1 for f in res.findings if f.baselined),
+            "stale_baseline": res.stale_baseline,
+            "files": len(res.files),
+            "parse_errors": [
+                {"path": p, "message": m} for p, m in res.parse_errors],
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for bid in res.stale_baseline:
+            print(f"note: stale baseline entry {bid} — no current "
+                  "finding matches; remove it")
+        if not new:
+            print(f"tpu-race clean: {len(res.files)} files, "
+                  f"{sum(1 for f in res.findings if f.baselined)} "
+                  "baselined, "
+                  f"{sum(1 for f in res.findings if f.suppressed)} "
+                  "suppressed")
+    if args.stats:
+        _print_stats(res, sys.stdout)
+    return 1 if new else 0

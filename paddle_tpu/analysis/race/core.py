@@ -1,0 +1,97 @@
+"""tpu-race — static thread-safety & allocator-lifetime analysis.
+
+The third analysis tier: tpu-lint (TPU0xx) checks the python that
+tracing erases, tpu-verify (TPU1xx) checks what tracing produces, and
+tpu-race (TPU2xx) checks the host-side concurrency AROUND the traced
+programs — lock discipline over shared mutable state and the
+dispatch/complete/release ordering of the async engine core.
+`analyze_paths` is the in-process API the tier-1 gate uses;
+`tools/tpu_race.py` is the CLI.
+
+Importing this package must not initialize a JAX backend — it reads
+only `paddle_tpu.jit.introspect` (pure metadata) from the framework,
+through the same `ModuleAnalysis` machinery tpu-lint uses.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..baseline import (BaselineError, apply_baseline, load_baseline,
+                        write_baseline)
+from ..core import _display_path, _module_name, _REPO_ROOT, collect_files
+from ..findings import (Finding, apply_suppressions, assign_ids,
+                        parse_suppressions)
+from .model import RaceModuleAnalysis
+from .rules import RACE_RULES, all_race_rule_ids
+
+__all__ = ["analyze_file", "analyze_paths", "collect_files", "Finding",
+           "Result", "RACE_RULES", "all_race_rule_ids",
+           "load_baseline", "apply_baseline", "write_baseline",
+           "BaselineError", "RaceModuleAnalysis", "_REPO_ROOT"]
+
+#: Same-line suppression tag: `# tpu-race: disable=TPU203`.
+SUPPRESS_TAG = "tpu-race"
+
+
+@dataclass
+class Result:
+    findings: list = field(default_factory=list)
+    files: list = field(default_factory=list)
+    parse_errors: list = field(default_factory=list)   # (path, message)
+    stale_baseline: list = field(default_factory=list)
+
+    def new_findings(self):
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def per_rule_counts(self):
+        out = {r: 0 for r in all_race_rule_ids()}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def analyze_file(path, src=None):
+    """-> (findings, model) for one file (IDs not yet assigned). A
+    syntax error yields a single TPU200 finding — unparseable files
+    are REPORTED, never silently dropped."""
+    display = _display_path(path)
+    if src is None:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+    try:
+        mod = RaceModuleAnalysis(display, src,
+                                 module_name=_module_name(path))
+    except SyntaxError as e:
+        return [Finding(rule="TPU200", path=display,
+                        line=e.lineno or 1, col=(e.offset or 1) - 1,
+                        message=f"unparseable file: {e.msg}")], None
+    findings = []
+    for rule_id in all_race_rule_ids():
+        check = RACE_RULES[rule_id][2]
+        if check is not None:
+            findings.extend(check(mod))
+    apply_suppressions(findings,
+                       parse_suppressions(src, tag=SUPPRESS_TAG))
+    return findings, mod
+
+
+def analyze_paths(paths, baseline=None):
+    """Analyze files/directories. `baseline` is {id: entry} (see
+    load_baseline). Returns Result with stable IDs assigned and
+    suppressions/baseline applied."""
+    res = Result()
+    for path in collect_files(paths):
+        findings, _mod = analyze_file(path)
+        res.files.append(_display_path(path))
+        for f in findings:
+            if f.rule == "TPU200":
+                res.parse_errors.append((f.path, f.message))
+        res.findings.extend(findings)
+    assign_ids(res.findings)
+    if baseline:
+        res.stale_baseline = apply_baseline(res.findings, baseline)
+    else:
+        res.stale_baseline = []
+    res.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return res

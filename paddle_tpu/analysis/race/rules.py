@@ -1,0 +1,219 @@
+"""tpu-race rules (TPU2xx): lock discipline + allocator lifetime.
+
+Each check takes a `RaceModuleAnalysis` and returns Finding objects.
+The TPU2xx namespace sits beside tpu-lint's TPU0xx (AST trace-safety)
+and tpu-verify's TPU1xx (jaxpr contracts); a registry test asserts the
+three stay disjoint.
+"""
+from __future__ import annotations
+
+import ast
+
+from paddle_tpu.jit import introspect as I
+
+from .model import CTOR_NAMES
+
+
+def _grouped(mod):
+    """accesses grouped by shared-state key, deterministic order."""
+    groups = {}
+    for a in mod.accesses:
+        groups.setdefault(a.key, []).append(a)
+    return sorted(groups.items())
+
+
+def _line(a):
+    return getattr(a.node, "lineno", 0)
+
+
+def check_tpu201(mod):
+    """unguarded-shared-mutable: an attribute/global written by
+    helper-thread-reachable code with NO lock held (and no guarded-by
+    assertion, no threading.local confinement) while step-thread code
+    also touches it."""
+    if not mod.thread_reachable:
+        return []
+    findings = []
+    for key, accs in _grouped(mod):
+        thread_writes = sorted(
+            (a for a in accs if a.in_thread and a.kind == "write"
+             and not a.locks), key=_line)
+        if not thread_writes:
+            continue
+        step_side = sorted(
+            (a for a in accs if not a.in_thread
+             and a.fi.name not in CTOR_NAMES), key=_line)
+        if not step_side:
+            continue
+        touch = step_side[0]
+        for a in thread_writes:
+            findings.append(mod.finding(
+                "TPU201", a.node,
+                f"{a.name()} is written on a helper thread with no "
+                f"lock held, but the step thread touches it too "
+                f"(line {_line(touch)}); hold one common lock on both "
+                "sides, confine it via threading.local, or assert the "
+                "caller's lock with '# guarded-by: <lock>'", a.fi))
+    return findings
+
+
+def check_tpu202(mod):
+    """inconsistent-guard: one attribute written under a lock in one
+    place and with no lock (or a different lock) in another. Unlocked
+    thread-side writes are TPU201's domain and skipped here; reads
+    are deliberately out of scope (racy snapshot reads are a
+    documented idiom — see the metrics `.value` properties)."""
+    findings = []
+    for key, accs in _grouped(mod):
+        writes = sorted((a for a in accs if a.kind == "write"
+                         and a.fi.name not in CTOR_NAMES), key=_line)
+        locked = [a for a in writes if a.locks]
+        if not locked:
+            continue
+        primary = sorted(locked[0].locks)[0]
+        for a in writes:
+            if a.locks and primary in a.locks:
+                continue
+            if a.locks:
+                other = sorted(a.locks)[0]
+                msg = (f"{a.name()} is written under lock '{other}' "
+                       f"here but under '{primary}' at line "
+                       f"{_line(locked[0])} — one attribute, one lock")
+            else:
+                if a.in_thread and mod.thread_reachable:
+                    continue           # TPU201 reports that shape
+                msg = (f"{a.name()} is written under lock '{primary}' "
+                       f"at line {_line(locked[0])} but with no lock "
+                       "here; hold the same lock or assert the "
+                       "caller's with '# guarded-by: <lock>'")
+            findings.append(mod.finding("TPU202", a.node, msg, a.fi))
+    return findings
+
+
+def check_tpu203(mod):
+    """free-before-complete: an allocator release (introspect
+    ALLOCATOR_RELEASE_EFFECTS) reachable on a path between a recorded
+    dispatch (ENGINE_DISPATCH_EFFECTS) and its completion
+    (STEP_COMPLETE_CALLS) — the zombie-write hazard that holds the
+    async pipe at depth 1 (DESIGN_DECISIONS r21/r22). Loop bodies
+    replay twice in the effect walk, so the depth-2 shape (iteration
+    N+1 frees before waiting on iteration N's dispatch) fires too.
+
+    `if` arms fork the outstanding-dispatch state (exclusive arms
+    can't see each other's dispatches); the merge is pessimistic —
+    a dispatch surviving on ANY non-diverging arm stays outstanding,
+    and an arm ending in return/raise/break/continue drops out of
+    the merge entirely (early-return guards read as guards)."""
+    findings = []
+    seen = set()
+    for fi in mod.functions:
+        outstanding = None
+        forks = []      # [saved_state, [non-diverged arm exit states]]
+        for kind, node, detail in mod.effect_seq(fi):
+            if kind == "dispatch":
+                outstanding = node
+            elif kind == "complete":
+                outstanding = None
+            elif kind == "fork":
+                forks.append([outstanding, []])
+            elif kind == "alt":
+                if forks:
+                    saved, rec = forks[-1]
+                    if not detail:
+                        rec.append(outstanding)
+                    outstanding = saved
+            elif kind == "join":
+                if forks:
+                    saved, rec = forks.pop()
+                    if not detail:
+                        rec.append(outstanding)
+                    merged = None
+                    for st in rec:
+                        if st is not None:
+                            merged = st
+                    outstanding = merged if rec else saved
+            elif kind == "release" and outstanding is not None:
+                if outstanding is node:
+                    # dispatch and release both spliced from ONE
+                    # callee: reported inside that callee, not here
+                    continue
+                sig = (id(fi), getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), detail)
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                findings.append(mod.finding(
+                    "TPU203", node,
+                    f"allocator release '{detail}' is reachable "
+                    f"between the dispatch at line "
+                    f"{getattr(outstanding, 'lineno', 0)} and its "
+                    "completion — a dispatched step may still write "
+                    "the released blocks (zombie write); complete "
+                    "the in-flight step before releasing", fi))
+    return findings
+
+
+def check_tpu204(mod):
+    """blocking-call-under-lock: block_until_ready / Thread.join /
+    sleep / queue-get while holding a registry or allocator lock —
+    every other thread contending on that lock stalls behind device
+    or wall-clock time."""
+    findings = []
+    for node, fi, lock, what in mod.blocking_under_lock:
+        findings.append(mod.finding(
+            "TPU204", node,
+            f"blocking call {what} while holding lock '{lock}'; "
+            "move the wait outside the guarded region", fi))
+    return findings
+
+
+def check_tpu205(mod):
+    """thread-spawn-in-trace: jit-reachable code starting threads
+    (tpu-lint's reachability tables) — a spawn inside a traced
+    function runs ONCE at trace time and stages nothing."""
+    findings = []
+    for node, fi in mod.spawn_sites:
+        if not fi.traced:
+            continue
+        fname = mod.resolve(node.func)
+        what = fname if fname in I.THREAD_SPAWN_CALLS \
+            else f".{node.func.attr}(...)" \
+            if isinstance(node.func, ast.Attribute) else "thread spawn"
+        findings.append(mod.finding(
+            "TPU205", node,
+            f"jit-reachable code starts a thread ({what}); the spawn "
+            "runs once at trace time and is invisible to the compiled "
+            "program — hoist it out of the traced region", fi))
+    return findings
+
+
+#: rule id -> (name, description, check). TPU200 is the parse-error
+#: rule (no checker — emitted by analyze_file), mirroring TPU000.
+RACE_RULES = {
+    "TPU200": ("parse-error",
+               "file could not be parsed (reported, never skipped)",
+               None),
+    "TPU201": ("unguarded-shared-mutable",
+               "helper-thread write to shared state with no common "
+               "lock, confinement, or guarded-by annotation",
+               check_tpu201),
+    "TPU202": ("inconsistent-guard",
+               "attribute written under different locks, or both "
+               "with and without one",
+               check_tpu202),
+    "TPU203": ("free-before-complete",
+               "allocator release between a dispatched step and its "
+               "completion (zombie-write hazard)",
+               check_tpu203),
+    "TPU204": ("blocking-call-under-lock",
+               "block_until_ready/join/sleep/queue-get while holding "
+               "a lock",
+               check_tpu204),
+    "TPU205": ("thread-spawn-in-trace",
+               "jit-reachable code starts a thread",
+               check_tpu205),
+}
+
+
+def all_race_rule_ids():
+    return sorted(RACE_RULES)

@@ -192,8 +192,9 @@ class ElasticMaster:
         """Drop expired leases for good (must hold the lock). Ghost
         joiners would otherwise linger forever and a late heartbeat
         could resurrect one the resize already discounted."""
-        self._members = {k: v for k, v in self._members.items()
-                         if v["deadline"] is None or v["deadline"] > now}
+        self._members = {              # guarded-by: _lock
+            k: v for k, v in self._members.items()
+            if v["deadline"] is None or v["deadline"] > now}
 
     def live(self) -> dict:
         with self._lock:

@@ -318,8 +318,14 @@ class SSDSparseTable(MemorySparseTable):
         self._finalizer()
 
     def _touch(self, i):
-        self._lru.pop(i, None)
-        self._lru[i] = None
+        # under _db_lock like every other _lru mutation: callers
+        # (materialize, set_state_dict) invoke this AFTER releasing
+        # the lock, and relying on the PS service's external per-table
+        # lock instead would leave direct in-process users racing
+        # _maybe_evict's popitem
+        with self._db_lock:
+            self._lru.pop(i, None)
+            self._lru[i] = None
 
     def _spill_materialize(self, shard):
         base = type(shard)._materialize

@@ -343,6 +343,14 @@ class PagedKVCache:
     allocation that fails after eviction is the same stall it always
     was."""
 
+    #: Block-recycling surface declared in introspect (the
+    #: ENGINE_STEP_DONATION pattern: the framework names its effect
+    #: methods, tpu-race TPU203 reads the table — no method-name
+    #: strings live in the analyzer). Calling one of these between a
+    #: dispatched step and its completion is the zombie-write hazard.
+    RACE_RELEASE_METHODS = \
+        introspect.ALLOCATOR_RELEASE_EFFECTS["PagedKVCache"]
+
     def __init__(self, num_layers, num_blocks, block_size, num_heads,
                  head_dim, dtype=jnp.float32, mesh=None, mp_axis="mp",
                  kv_dtype=None):
@@ -720,6 +728,13 @@ class GenerationEngine:
     refuses a model left in training mode with active dropout, same as
     `generate(use_cache=True)`.
     """
+
+    #: Dispatch/complete surface of the (async) step pipeline, declared
+    #: in introspect so tpu-race TPU203 can order allocator releases
+    #: against in-flight device steps (see RACE_RELEASE_METHODS on
+    #: PagedKVCache / PagedAdapterPool).
+    RACE_DISPATCH_METHODS = introspect.ENGINE_DISPATCH_EFFECTS
+    RACE_COMPLETE_CALLS = introspect.STEP_COMPLETE_CALLS
 
     def __init__(self, model, num_slots=8, block_size=16,
                  num_blocks=None, prefill_buckets=None,

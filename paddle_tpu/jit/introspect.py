@@ -230,3 +230,98 @@ CONTRACTION_CALLS = {
 }
 
 ACCUM_DTYPE_KEYWORD = "preferred_element_type"
+
+# ---------------------------------------------------------------------------
+# Concurrency surfaces (tpu-race, TPU2xx)
+# ---------------------------------------------------------------------------
+
+#: Canonical constructors whose result is a mutual-exclusion guard —
+#: an attribute assigned from one of these (or from a name that itself
+#: looks like a lock) names a LOCK in tpu-race's lock-set analysis,
+#: and `with <that attribute>:` opens a guarded region.
+LOCK_CONSTRUCTORS = (
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+)
+
+#: Canonical constructor for thread-confined storage: every access
+#: whose base is an attribute assigned from one of these is exempt
+#: from the shared-mutable rule (the PhaseTimer discipline).
+THREAD_LOCAL_CONSTRUCTORS = ("threading.local",)
+
+#: Canonical callables that put a python callable on another thread.
+#: Maps canonical name -> (keyword, positional index) locating the
+#: callable argument — the seeds of tpu-race's thread-escape analysis
+#: (TPU201/TPU205), mirroring how TRACING_CALLABLES seeds tpu-lint's
+#: jit-reachability.
+THREAD_SPAWN_CALLS = {
+    "threading.Thread": ("target", 1),
+    "threading.Timer": ("function", 1),
+}
+
+#: Method attribute that hands its first positional argument to an
+#: executor's worker thread (concurrent.futures submit convention).
+EXECUTOR_SUBMIT_METHODS = ("submit",)
+
+#: Host-blocking calls for TPU204 (blocking-call-under-lock): the
+#: canonical free functions, plus method attributes that block when
+#: their receiver was built by one of BLOCKING_RECEIVER_TYPES (the
+#: receiver gate keeps `",".join(...)` and `dict.get` out).
+BLOCKING_CALLS = (
+    "time.sleep",
+    "jax.block_until_ready",
+)
+BLOCKING_METHODS = ("join", "get", "wait", "result", "acquire")
+BLOCKING_RECEIVER_TYPES = (
+    "threading.Thread",
+    "threading.Event",
+    "threading.Condition",
+    "threading.Lock",
+    "threading.RLock",
+    "queue.Queue",
+    "queue.SimpleQueue",
+    "queue.LifoQueue",
+    "queue.PriorityQueue",
+)
+
+# ---------------------------------------------------------------------------
+# Async-pipeline effect table (tpu-race TPU203)
+# ---------------------------------------------------------------------------
+# The ENGINE_STEP_DONATION precedent, applied to the dispatch-ahead
+# pipeline: the engine and the allocators DECLARE their effect surfaces
+# here, the race analyzer READS them — no magic method-name strings on
+# either side. Three effect classes:
+#
+# - DISPATCH: engine methods that issue a compiled step and return
+#   WITHOUT waiting on its output (they seat an `_InFlight` record).
+#   Between such a call and its completion the device may still be
+#   writing into allocator-managed KV blocks / adapter pages.
+# - COMPLETE: calls that synchronize outstanding device work — the
+#   explicit wait plus every host materialization the serial complete
+#   stages use (np.asarray IS the sync on the serial path).
+# - RELEASE: allocator methods that free or recycle device-visible
+#   pages. Calling one while a dispatch is outstanding is the
+#   zombie-write hazard of DESIGN_DECISIONS r21 — the reason the
+#   async pipe holds at depth 1.
+
+#: Engine methods that dispatch a compiled step without waiting.
+ENGINE_DISPATCH_EFFECTS = (
+    "_plain_dispatch",
+    "_spec_dispatch",
+    "_dispatch_ahead",
+)
+
+#: Calls that complete (synchronize) outstanding dispatches.
+STEP_COMPLETE_CALLS = ("jax.block_until_ready",) \
+    + tuple(sorted(HOST_SYNC_CALLS))
+
+#: Allocator release/recycle surface, by owning class. `free`/`release`
+#: drop references (blocks can re-enter the pool under an in-flight
+#: writer); `allocate`/`acquire` recycle evictable pages in place.
+ALLOCATOR_RELEASE_EFFECTS = {
+    "PagedKVCache": ("free", "allocate"),
+    "PagedAdapterPool": ("release", "acquire"),
+}

@@ -136,7 +136,7 @@ class _CounterChild:
             self._value += amount
 
     def _zero(self):
-        self._value = 0.0
+        self._value = 0.0            # guarded-by: _lock
 
     @property
     def value(self):
@@ -187,7 +187,7 @@ class _GaugeChild:
                 self._value = float(value)
 
     def _zero(self):
-        self._value = 0.0
+        self._value = 0.0            # guarded-by: _lock
 
     @property
     def value(self):
@@ -245,9 +245,9 @@ class _HistogramChild:
             self._count += 1
 
     def _zero(self):
-        self._counts = [0] * len(self._counts)
-        self._sum = 0.0
-        self._count = 0
+        self._counts = [0] * len(self._counts)   # guarded-by: _lock
+        self._sum = 0.0                          # guarded-by: _lock
+        self._count = 0                          # guarded-by: _lock
 
     @property
     def count(self):

@@ -1,0 +1,18 @@
+"""Same-line suppression: only the tagged line is exempt."""
+import threading
+
+
+class R:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def add(self):
+        with self._lock:
+            self._n += 1
+
+    def reset_a(self):
+        self._n = 0  # tpu-race: disable=TPU202
+
+    def reset_b(self):
+        self._n = 0

@@ -1,0 +1,20 @@
+"""TPU201 negative: a common lock on both sides, and thread-local
+scratch state confined by construction."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._t = threading.Thread(target=self._work, daemon=True)
+
+    def _work(self):
+        with self._lock:
+            self.count += 1
+        self._tls.scratch = 1
+
+    def step(self):
+        with self._lock:
+            return self.count

@@ -1,0 +1,14 @@
+"""TPU201 positive: helper-thread write, no common lock."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self.count = 0
+        self._t = threading.Thread(target=self._work, daemon=True)
+
+    def _work(self):
+        self.count += 1
+
+    def step(self):
+        return self.count

@@ -1,0 +1,20 @@
+"""TPU202 negative: one lock everywhere; the lock-free helper asserts
+its callers' lock with a guarded-by annotation."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0.0
+
+    def add(self, amount):
+        with self._lock:
+            self._total += amount
+
+    def _zero(self):
+        self._total = 0.0        # guarded-by: _lock
+
+    def reset(self):
+        with self._lock:
+            self._zero()

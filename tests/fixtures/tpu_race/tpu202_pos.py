@@ -1,0 +1,31 @@
+"""TPU202 positive: locked write in one method, bare write in
+another; and one attribute guarded by two different locks."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0.0
+
+    def add(self, amount):
+        with self._lock:
+            self._total += amount
+
+    def reset(self):
+        self._total = 0.0
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._n = 0
+
+    def f(self):
+        with self._a:
+            self._n += 1
+
+    def g(self):
+        with self._b:
+            self._n += 1

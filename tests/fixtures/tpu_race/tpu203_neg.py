@@ -1,0 +1,21 @@
+"""TPU203 negative: the same depth-2 pipe with the fixed ordering —
+complete the in-flight step, THEN recycle its blocks, then dispatch."""
+import jax
+
+
+class Pipe:
+    def __init__(self, cache):
+        self.cache = cache
+        self.inflight = None
+
+    def run(self, steps):
+        for work in steps:
+            if self.inflight is None:
+                self.inflight = self._plain_dispatch(work)
+                continue
+            jax.block_until_ready(self.inflight.out)
+            self.cache.free(self.inflight.blocks)
+            self.inflight = self._plain_dispatch(work)
+
+    def _plain_dispatch(self, work):
+        return work

@@ -1,0 +1,22 @@
+"""TPU203 positive: a depth-2 async pipe that frees the previous
+iteration's blocks BEFORE waiting on its dispatched step — the
+zombie-write hazard (a dispatched step may still write the blocks)."""
+import jax
+
+
+class Pipe:
+    def __init__(self, cache):
+        self.cache = cache
+        self.inflight = None
+
+    def run(self, steps):
+        for work in steps:
+            if self.inflight is None:
+                self.inflight = self._plain_dispatch(work)
+                continue
+            self.cache.free(self.inflight.blocks)
+            jax.block_until_ready(self.inflight.out)
+            self.inflight = self._plain_dispatch(work)
+
+    def _plain_dispatch(self, work):
+        return work

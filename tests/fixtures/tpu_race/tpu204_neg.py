@@ -1,0 +1,27 @@
+"""TPU204 negative: waits happen outside the guarded region, and a
+str.join under the lock is not a blocking call."""
+import queue
+import threading
+
+import jax
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._noop, daemon=True)
+        self._names = []
+
+    def _noop(self):
+        pass
+
+    def wait_out(self, out):
+        jax.block_until_ready(out)
+        with self._lock:
+            self._names.append("done")
+
+    def drain(self):
+        item = self._q.get()
+        with self._lock:
+            return ",".join(item)

@@ -1,0 +1,28 @@
+"""TPU204 positive: device waits, queue gets and thread joins while
+holding a lock."""
+import queue
+import threading
+
+import jax
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._noop, daemon=True)
+
+    def _noop(self):
+        pass
+
+    def wait_out(self, out):
+        with self._lock:
+            jax.block_until_ready(out)
+
+    def drain(self):
+        with self._lock:
+            return self._q.get()
+
+    def join_worker(self):
+        with self._lock:
+            self._t.join()

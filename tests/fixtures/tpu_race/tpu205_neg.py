@@ -1,0 +1,13 @@
+"""TPU205 negative: the spawn lives outside any traced region."""
+import threading
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x + 1
+
+
+def launch(x):
+    threading.Thread(target=print, args=(x,), daemon=True).start()

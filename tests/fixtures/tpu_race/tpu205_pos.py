@@ -1,0 +1,15 @@
+"""TPU205 positive: jit-reachable code starts a thread (runs once at
+trace time, stages nothing)."""
+import threading
+
+import jax
+
+
+@jax.jit
+def step(x):
+    _log_async(x)
+    return x + 1
+
+
+def _log_async(x):
+    threading.Thread(target=print, args=(x,), daemon=True).start()

@@ -1,0 +1,2 @@
+"""TPU200: this file does not parse (reported, never skipped)."""
+def broken(:

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""tpu-race — static thread-safety & allocator-lifetime analyzer.
+
+Usage:
+    python tools/tpu_race.py paddle_tpu bench_ops.py tools
+    python tools/tpu_race.py --stats --format=json some/file.py
+    python tools/tpu_race.py --list-rules
+
+See README "Race analysis" for the rule table, the guarded-by
+annotation etiquette, and the suppression tag. Runs as a tier-1 gate
+(tests/test_tpu_race_gate.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.race.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

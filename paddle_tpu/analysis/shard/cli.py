@@ -1,0 +1,189 @@
+"""tpu-shard CLI implementation (thin wrapper lives in
+tools/tpu_shard.py), mirroring the sibling tiers' interface.
+
+Exit codes: 0 clean (against baselines), 1 findings, 2 usage/baseline
+error — the tpu-lint convention.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..baseline import BaselineError, load_baseline, write_baseline
+from .core import (DEFAULT_SHARD_BASELINE, _REPO_ROOT,
+                   load_shard_baseline, verify_shards,
+                   write_shard_baseline)
+from .rules import SHARD_RULES, all_shard_rule_ids
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools",
+                                "tpu_shard_baseline.json")
+
+
+def _print_stats(res, out):
+    counts = res.per_rule_counts()
+    suppressed = sum(1 for f in res.findings if f.suppressed)
+    baselined = sum(1 for f in res.findings if f.baselined)
+    print("-- tpu-shard stats -----------------------------------",
+          file=out)
+    print(f"programs analyzed: {len(res.records)}", file=out)
+    for rec in res.records:
+        axes = {axis: {k: f"{v['count']}x/{v['moved_bytes']}B"
+                       for k, v in kinds.items()}
+                for axis, kinds in rec.axis_totals.items()}
+        print(f"  {rec.key}: axes={axes or '{}'}", file=out)
+    for rule in all_shard_rule_ids():
+        name = SHARD_RULES[rule][0]
+        print(f"{rule} {name:<30} {counts.get(rule, 0)}", file=out)
+    print(f"suppressed inline/waived: {suppressed}   "
+          f"baselined: {baselined}", file=out)
+
+
+def main(argv=None, programs=None):
+    """`programs` injects already-harvested TracedPrograms (the unit
+    tests' seam — the default path harvests the full matrix)."""
+    ap = argparse.ArgumentParser(
+        prog="tpu_shard",
+        description="static sharding-layout & per-axis "
+                    "collective-byte analyzer")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories; only programs whose "
+                         "contract is DECLARED under one of them are "
+                         "checked (default: all harvested programs)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="findings baseline JSON ('none' disables; "
+                         "default: tools/tpu_shard_baseline.json "
+                         "when present)")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current new findings as a baseline "
+                         "skeleton (justifications left empty on "
+                         "purpose) and exit")
+    ap.add_argument("--shard-baseline", default=None,
+                    help="byte-drift snapshot JSON ('none' disables; "
+                         "default: SHARD_BASELINE.json at the repo "
+                         "root when present)")
+    ap.add_argument("--write-shard-baseline", nargs="?",
+                    metavar="PATH", const=DEFAULT_SHARD_BASELINE,
+                    help="re-snapshot per-program per-axis collective "
+                         "byte totals (default path: the committed "
+                         "SHARD_BASELINE.json) and exit")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-program axis/byte totals and "
+                         "per-rule finding counts")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_shard_rule_ids():
+            name, desc, _ = SHARD_RULES[rule]
+            print(f"{rule}  {name:<30} {desc}")
+        return 0
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpu_shard: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    baseline = {}
+    if args.baseline != "none" and not args.write_baseline:
+        bpath = args.baseline or (
+            DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE)
+            else None)
+        if args.baseline and not os.path.exists(args.baseline):
+            print(f"tpu_shard: baseline not found: {args.baseline}",
+                  file=sys.stderr)
+            return 2
+        if bpath:
+            try:
+                baseline = load_baseline(bpath)
+            except (BaselineError, json.JSONDecodeError) as e:
+                print(f"tpu_shard: bad baseline {bpath}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    # resolve AND load the drift snapshot BEFORE the (expensive)
+    # harvest — a corrupt file is a usage error, not a late traceback
+    shard_baseline = None
+    if not args.write_shard_baseline and args.shard_baseline != "none":
+        sb_path = args.shard_baseline or (
+            DEFAULT_SHARD_BASELINE
+            if os.path.exists(DEFAULT_SHARD_BASELINE) else None)
+        if args.shard_baseline and not os.path.exists(
+                args.shard_baseline):
+            print("tpu_shard: shard baseline not found: "
+                  f"{args.shard_baseline}", file=sys.stderr)
+            return 2
+        if sb_path:
+            try:
+                shard_baseline = load_shard_baseline(sb_path)
+            except (json.JSONDecodeError, OSError) as e:
+                print(f"tpu_shard: bad shard baseline {sb_path}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    try:
+        if programs is not None:
+            from .core import analyze_programs, filter_programs
+
+            res = analyze_programs(
+                filter_programs(programs, args.paths),
+                baseline=baseline, shard_baseline=shard_baseline)
+        else:
+            res = verify_shards(paths=args.paths, baseline=baseline,
+                                shard_baseline=shard_baseline)
+    except RuntimeError as e:
+        print(f"tpu_shard: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_shard_baseline:
+        n = write_shard_baseline(args.write_shard_baseline,
+                                 res.records)
+        print(f"snapshotted {n} programs to "
+              f"{args.write_shard_baseline} — review the diff before "
+              "committing")
+        return 0
+
+    if args.write_baseline:
+        # TPU300 drift is never grandfatherable (see core) — its
+        # acceptance path is --write-shard-baseline, reviewed
+        n = write_baseline(args.write_baseline,
+                           [f for f in res.new_findings()
+                            if f.rule != "TPU300"])
+        print(f"wrote {n} entries to {args.write_baseline} — add a "
+              "justification to each (the loader rejects empty ones; "
+              "TPU300 drift is never grandfatherable)")
+        return 0
+
+    new = res.new_findings()
+    if args.format == "json":
+        doc = {
+            "findings": [f.to_dict() for f in new],
+            "suppressed": sum(1 for f in res.findings if f.suppressed),
+            "baselined": sum(1 for f in res.findings if f.baselined),
+            "stale_baseline": res.stale_baseline,
+            "stale_shard_baseline": res.stale_shard_baseline,
+            "programs": [rec.key for rec in res.records],
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for bid in res.stale_baseline:
+            print(f"note: stale baseline entry {bid} — no current "
+                  "finding matches; remove it")
+        for key in res.stale_shard_baseline:
+            print(f"note: stale SHARD_BASELINE entry {key} — no "
+                  "current program matches; re-snapshot")
+        if not new:
+            print(f"tpu-shard clean: {len(res.records)} programs, "
+                  f"{sum(1 for f in res.findings if f.baselined)} "
+                  "baselined, "
+                  f"{sum(1 for f in res.findings if f.suppressed)} "
+                  "suppressed")
+    if args.stats:
+        _print_stats(res, sys.stdout)
+    return 1 if new else 0

@@ -1,0 +1,251 @@
+"""tpu-shard driver: records, drift snapshot, suppressions, results.
+
+Consumes the tpu-verify harvest (`analysis.trace.harvest`) — tpu-shard
+lowers NOTHING itself, so the two tiers can never disagree about what
+a program's jaxpr or StableHLO looks like — wraps each TracedProgram
+in a `model.ShardRecord`, runs the TPU3xx rules, and compares
+per-program per-axis byte totals against the committed
+`SHARD_BASELINE.json` (drift = TPU300; the reviewed acceptance path is
+`tools/tpu_shard.py --write-shard-baseline`, mirroring tpu-verify's
+TRACE_BASELINE).
+
+Inline suppressions use the `tpu-shard` tag (same-line, at the
+contract's declaration anchor), a namespace disjoint from
+tpu-lint's and tpu-race's — `# tpu-shard: disable=TPU301`.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..baseline import (BaselineError, apply_baseline, load_baseline,
+                        write_baseline)
+from ..findings import (Finding, apply_suppressions, assign_ids,
+                        parse_suppressions)
+from .model import build_record
+from .rules import all_shard_rule_ids, check_record
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+#: Committed drift snapshot (repo root, TRACE_BASELINE.json precedent).
+DEFAULT_SHARD_BASELINE = os.path.join(_REPO_ROOT, "SHARD_BASELINE.json")
+
+SUPPRESS_TAG = "tpu-shard"
+
+__all__ = [
+    "ShardResult", "analyze_programs", "verify_shards", "snapshot_of",
+    "load_shard_baseline", "write_shard_baseline", "compare_snapshot",
+    "load_baseline", "apply_baseline", "write_baseline",
+    "BaselineError", "Finding", "SUPPRESS_TAG",
+    "DEFAULT_SHARD_BASELINE",
+]
+
+
+class ShardResult:
+    """Mirror of the sibling tiers' Result records."""
+
+    def __init__(self):
+        self.findings = []
+        self.records = []
+        self.stale_baseline = []        # findings-baseline ids
+        self.stale_shard_baseline = []  # snapshot keys
+
+    @property
+    def programs(self):
+        return [r.prog for r in self.records]
+
+    def new_findings(self):
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def per_rule_counts(self):
+        out = {r: 0 for r in all_shard_rule_ids()}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+# ---------------------------------------------------------------------------
+# drift snapshot (SHARD_BASELINE.json / TPU300)
+# ---------------------------------------------------------------------------
+
+def snapshot_of(records):
+    """program key -> per-axis per-kind {count, moved_bytes} totals —
+    the unit of the committed byte-drift baseline. Every harvested
+    program gets an entry (mp=1 and conv programs pin an EMPTY axes
+    map: growing a collective where none existed is drift too)."""
+    return {rec.key: {"axes": {
+        axis: {kind: dict(v) for kind, v in sorted(kinds.items())}
+        for axis, kinds in sorted(rec.axis_totals.items())}}
+        for rec in records}
+
+
+def load_shard_baseline(path):
+    with open(path) as f:
+        data = json.load(f)
+    return data.get("programs", data)
+
+
+def write_shard_baseline(path, records):
+    with open(path, "w") as f:
+        json.dump({"version": 1, "programs": snapshot_of(records)},
+                  f, indent=1, sort_keys=True)
+        f.write("\n")
+    return len(records)
+
+
+def _diff_axes(cur, base):
+    bits = []
+    c, b = cur.get("axes", {}), base.get("axes", {})
+    for axis in sorted(set(c) | set(b)):
+        ck, bk = c.get(axis, {}), b.get(axis, {})
+        for kind in sorted(set(ck) | set(bk)):
+            cv = ck.get(kind, {"count": 0, "moved_bytes": 0})
+            bv = bk.get(kind, {"count": 0, "moved_bytes": 0})
+            if cv != bv:
+                bits.append(
+                    f"{axis}/{kind} {bv['count']}x/"
+                    f"{bv['moved_bytes']}B -> {cv['count']}x/"
+                    f"{cv['moved_bytes']}B")
+    return "; ".join(bits[:6]) + (" ..." if len(bits) > 6 else "")
+
+
+def compare_snapshot(records, baseline):
+    """-> (drift findings [TPU300], stale baseline keys). Exact-match
+    per-axis byte comparison — any change in what a program moves
+    over the mesh fails loudly until --write-shard-baseline
+    re-snapshots it and the diff is reviewed."""
+    current = snapshot_of(records)
+    by_key = {rec.key: rec for rec in records}
+    findings = []
+    for key in sorted(current):
+        rec = by_key[key]
+        if key not in baseline:
+            findings.append(Finding(
+                rule="TPU300", path=rec.contract.declared_at, line=1,
+                col=0, qualname=rec.contract.name,
+                source=rec.prog.config,
+                message=f"program {key} has no SHARD_BASELINE.json "
+                        "entry — run tools/tpu_shard.py "
+                        "--write-shard-baseline and review the "
+                        "snapshot"))
+        elif current[key] != baseline[key]:
+            findings.append(Finding(
+                rule="TPU300", path=rec.contract.declared_at, line=1,
+                col=0, qualname=rec.contract.name,
+                source=rec.prog.config,
+                message=f"program {key} drifted from "
+                        "SHARD_BASELINE.json: "
+                        f"{_diff_axes(current[key], baseline[key])}"
+                        " — intentional? re-snapshot with "
+                        "--write-shard-baseline"))
+    stale = sorted(set(baseline) - set(current))
+    return findings, stale
+
+
+# ---------------------------------------------------------------------------
+# the full check
+# ---------------------------------------------------------------------------
+
+def _apply_shard_suppressions(findings, sources=None):
+    """Same-line `# tpu-shard: disable=...` suppression at each
+    finding's anchor (the contract declaration file). `sources` maps
+    path -> text for tests; otherwise anchors resolve against the
+    repo root."""
+    by_path = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for path, fs in by_path.items():
+        src = (sources or {}).get(path)
+        if src is None:
+            full = path if os.path.isabs(path) \
+                else os.path.join(_REPO_ROOT, path)
+            if not os.path.exists(full):
+                continue
+            with open(full, encoding="utf-8") as fh:
+                src = fh.read()
+        apply_suppressions(
+            fs, parse_suppressions(src, tag=SUPPRESS_TAG))
+    return findings
+
+
+def analyze_programs(programs, baseline=None, shard_baseline=None,
+                     axis_sizes=None, sources=None):
+    """Run the TPU3xx rules (+ drift comparison) over already-
+    harvested TracedPrograms — the in-process API (the gate and the
+    fixtures drive this; `verify_shards` adds the harvest).
+
+    `baseline` is a loaded findings baseline ({id: entry}) or None;
+    `shard_baseline` a loaded snapshot dict, a path, or None to skip
+    drift checking; `axis_sizes` overrides the mesh axis sizes
+    ({"mp": prog.mp} by default) for fixture meshes."""
+    res = ShardResult()
+    res.records = [build_record(p, axis_sizes) for p in programs]
+    for rec in res.records:
+        res.findings.extend(check_record(rec))
+        if rec.parse_error:
+            res.findings.append(Finding(
+                rule="TPU300", path=rec.contract.declared_at, line=1,
+                col=0, qualname=rec.contract.name,
+                source=rec.prog.config,
+                message=f"lowered module for {rec.key} did not "
+                        f"parse: {rec.parse_error} — the sharding "
+                        "surface is unverifiable"))
+    if isinstance(shard_baseline, str):
+        shard_baseline = load_shard_baseline(shard_baseline)
+    if shard_baseline is not None:
+        drift, res.stale_shard_baseline = compare_snapshot(
+            res.records, shard_baseline)
+        res.findings.extend(drift)
+    _apply_shard_suppressions(res.findings, sources)
+    assign_ids(res.findings)
+    if baseline:
+        # TPU300 is excluded from the findings baseline, exactly like
+        # tpu-verify's TPU100: a drift finding's stable ID hashes the
+        # program key, not the drift content, so one grandfathered
+        # entry would mask every FUTURE byte drift of that program.
+        # Drift acceptance is --write-shard-baseline, reviewed.
+        res.stale_baseline = apply_baseline(
+            [f for f in res.findings if f.rule != "TPU300"], baseline)
+    res.findings.sort(key=lambda f: (f.path, f.qualname, f.source,
+                                     f.rule))
+    return res
+
+
+def _norm_prefix(path):
+    rel = os.path.relpath(os.path.abspath(path), _REPO_ROOT)
+    return rel.replace(os.sep, "/").rstrip("/")
+
+
+def filter_programs(programs, paths):
+    """Restrict to programs whose contract is DECLARED under one of
+    `paths` (repo-relative or absolute files/directories) — the CLI's
+    positional-path semantics: `tools/tpu_shard.py paddle_tpu/`
+    checks every program declared in the tree."""
+    if not paths:
+        return list(programs)
+    prefixes = [_norm_prefix(p) for p in paths]
+    out = []
+    for p in programs:
+        declared = p.contract.declared_at
+        if any(declared == pre or declared.startswith(pre + "/")
+               for pre in prefixes):
+            out.append(p)
+    return out
+
+
+def verify_shards(matrix=None, paths=None, baseline=None,
+                  shard_baseline="auto"):
+    """Harvest the tpu-verify matrix and run every TPU3xx rule + the
+    byte-drift comparison. `shard_baseline` is a path, a loaded
+    snapshot dict, "auto" (the committed SHARD_BASELINE.json when
+    present) or None."""
+    from ..trace.harvest import harvest
+
+    programs = filter_programs(harvest(matrix), paths)
+    if shard_baseline == "auto":
+        shard_baseline = DEFAULT_SHARD_BASELINE \
+            if os.path.exists(DEFAULT_SHARD_BASELINE) else None
+    return analyze_programs(programs, baseline=baseline,
+                            shard_baseline=shard_baseline)

@@ -1,0 +1,274 @@
+"""TPU3xx rules — sharding-layout & collective-byte checks over
+harvested programs.
+
+Each rule is a pure function over a `model.ShardRecord` returning
+`analysis.findings.Finding`s anchored at the contract's declaration
+site (the step builder, same convention as the trace tier). TPU300 is
+the meta-rule for SHARD_BASELINE.json drift, reported by
+`core.compare_snapshot` like tpu-verify's TPU100.
+
+No jax import (the import-smoke contract): everything a rule reads
+was extracted by `model` from the jaxpr walk, the lowered text and
+the declared spec tuples the harvester captured.
+"""
+from __future__ import annotations
+
+from ..findings import Finding
+from .model import LARGE_BUFFER_BYTES, eval_payload
+
+
+def _finding(rule, rec, message):
+    return Finding(rule=rule, path=rec.contract.declared_at, line=1,
+                   col=0, message=message,
+                   qualname=rec.contract.name, source=rec.prog.config)
+
+
+def _fmt_spec(spec):
+    if spec == ():
+        return "replicated"
+    return "P(" + ", ".join("None" if a is None else repr(a)
+                            for a in spec) + ")"
+
+
+def _fmt_counts(counts):
+    if counts is None:
+        return "unspecified"
+    if counts == ():
+        return "replicated"
+    return "split " + "x".join(str(c) for c in counts)
+
+
+def _max_bound(rec, axis, kind):
+    """Largest declared payload bound (bytes) for (axis, kind), or
+    None when the kind is undeclared on that axis."""
+    bounds = rec.axis_budget.payload_bounds(axis, kind)
+    if not bounds or rec.prog.geometry is None:
+        return None
+    return max(eval_payload(b, rec.prog.geometry) for b in bounds)
+
+
+def check_tpu301(rec):
+    """TPU301 undeclared-resharding: every collective must cross an
+    axis the budget DECLARES, at a declared kind, within the declared
+    count, and the per-axis moved-byte total must stay under the
+    budget-derived cap ((per_layer x layers + fixed) x payload bound x
+    (axis_size - 1)). An all-gather over an axis the table never
+    mentions — or mp-axis traffic growing past what the declared
+    payloads can account for — is a resharding nobody signed off on,
+    the silent DCN-saturating surprise class."""
+    if not rec.sites:
+        return []
+    out = []
+    budget = rec.axis_budget
+    if budget is None:
+        kinds = sorted({s.kind for s in rec.sites})
+        return [_finding(
+            "TPU301", rec,
+            f"program runs {', '.join(kinds)} but its contract "
+            "declares no per-axis collective budget "
+            "(AxisCollectiveBudget) — every collective is an "
+            "undeclared resharding")]
+    declared = set(budget.axis_names())
+    L = rec.prog.num_layers
+    for axis in sorted(rec.axis_totals):
+        per_kind = rec.axis_totals[axis]
+        if axis not in declared:
+            kinds = ", ".join(f"{k} x{v['count']}"
+                              for k, v in sorted(per_kind.items()))
+            out.append(_finding(
+                "TPU301", rec,
+                f"collectives cross mesh axis '{axis}' which the "
+                f"budget does not declare ({kinds}) — undeclared "
+                "resharding"))
+            continue
+        size = int(rec.axis_sizes.get(axis, 1))
+        for kind in sorted(per_kind):
+            n = per_kind[kind]["count"]
+            moved = per_kind[kind]["moved_bytes"]
+            allowed = budget.allowed_on_axis(axis, kind, L)
+            if n > allowed:
+                out.append(_finding(
+                    "TPU301", rec,
+                    f"{kind} crosses axis '{axis}' {n}x "
+                    f"({moved} bytes moved), allowed {allowed} — "
+                    "an undeclared resharding joined the step"))
+                continue
+            bound = _max_bound(rec, axis, kind)
+            if bound is not None:
+                cap = allowed * bound * max(size - 1, 1)
+                if moved > cap:
+                    out.append(_finding(
+                        "TPU301", rec,
+                        f"{kind} traffic over axis '{axis}' moves "
+                        f"{moved} bytes, budget caps "
+                        f"{cap} (= {allowed} x {bound}-byte payload "
+                        f"bound x {max(size - 1, 1)} peers) — the "
+                        "payloads outgrew the declared layout"))
+    return out
+
+
+def check_tpu302(rec):
+    """TPU302 replicated-large-buffer: a signature leaf above
+    LARGE_BUFFER_BYTES that the declared layout truth (pool_pspec /
+    _tp_specs / adapter pool_pspecs) says SHARDED but that lowered
+    replicated (or with no sharding at all) — the exact drift class
+    TPU101 caught for donation: the buffer silently costs
+    axis_size x its HBM share on every chip."""
+    if not rec.sharded:
+        return []
+    out = []
+    for side, i, spec, counts, nbytes in rec.declared_vs_lowered():
+        if not any(a is not None for a in spec):
+            continue                      # declared replicated
+        if counts not in ((), None) or nbytes < LARGE_BUFFER_BYTES:
+            continue
+        out.append(_finding(
+            "TPU302", rec,
+            f"{side}put leaf #{i} ({nbytes} bytes) is declared "
+            f"{_fmt_spec(spec)} but lowered "
+            f"{_fmt_counts(counts)} — a sharded buffer silently "
+            "replicated onto every chip"))
+    return out
+
+
+def check_tpu303(rec):
+    """TPU303 pspec-layout drift: any declared-layout leaf (donated
+    pool, scale grid, adapter page array, weight leaf) whose lowered
+    sharding differs from what the declared PartitionSpec demands —
+    sharded on the wrong dim, sharded where declared replicated, or
+    missing from the signature entirely. The large
+    declared-sharded-but-replicated case is TPU302's (one finding per
+    drift, the sharper rule wins)."""
+    if not rec.sharded:
+        return []
+    out = []
+    for side, i, spec, counts, nbytes in rec.declared_vs_lowered():
+        declared_sharded = any(a is not None for a in spec)
+        if declared_sharded and counts in ((), None) \
+                and nbytes >= LARGE_BUFFER_BYTES:
+            continue                      # TPU302's finding
+        if counts is None:
+            if declared_sharded:
+                out.append(_finding(
+                    "TPU303", rec,
+                    f"{side}put leaf #{i} is declared "
+                    f"{_fmt_spec(spec)} but carries no lowered "
+                    "sharding (missing from the @main signature or "
+                    "unspecified) — the declared layout never "
+                    "reached the compiler"))
+            continue
+        expected = rec.expected_counts(spec, len(counts) or len(spec))
+        if counts != expected:
+            out.append(_finding(
+                "TPU303", rec,
+                f"{side}put leaf #{i} is declared {_fmt_spec(spec)} "
+                f"(expects {_fmt_counts(expected)}) but lowered "
+                f"{_fmt_counts(counts)} — the compiled layout "
+                "drifted from the declared plan"))
+    return out
+
+
+def check_tpu304(rec):
+    """TPU304 axis-unsafe collective shape: a collective whose GLOBAL
+    payload exceeds the budget's declared axis-size-invariant bound.
+    The bound is written over the serving geometry only (tokens,
+    hidden, vocab, ...), so a payload that scales with the mesh —
+    gathering an already-gathered activation, reducing a buffer that
+    grew by axis_size — lands above it at ANY size: the bug class
+    that makes mp=4 quietly move 2x mp=2's bytes."""
+    budget = rec.axis_budget
+    if budget is None or not rec.sites:
+        return []
+    out = []
+    for s in rec.sites:
+        for axis in s.axes:
+            bound = _max_bound(rec, axis, s.kind)
+            if bound is None:
+                continue                  # undeclared kind: TPU301's
+            if s.global_bytes > bound:
+                out.append(_finding(
+                    "TPU304", rec,
+                    f"{s.kind} over axis '{axis}' carries a "
+                    f"{s.global_bytes}-byte global payload, declared "
+                    f"bound {bound} bytes — the payload is not "
+                    "invariant to the axis size it crosses"))
+    return out
+
+
+def check_tpu305(rec):
+    """TPU305 dcn-hostile collective: a collective crossing a budget
+    axis declared "dcn" (slow inter-slice link) from a latency-bound
+    position — a per-token program (the decode/verify host loop body)
+    or an on-device loop body. Forward-looking for ROADMAP item 1:
+    the moment a 'pp' DCN axis exists, a per-token all-gather across
+    it fails here instead of flooring serving throughput on
+    hardware."""
+    budget = rec.axis_budget
+    if budget is None or not rec.sites:
+        return []
+    slow = set(budget.slow_axes())
+    if not slow:
+        return []
+    out = []
+    for s in rec.sites:
+        hot = rec.contract.per_token or s.in_loop
+        for axis in s.axes:
+            if axis in slow and hot:
+                where = ("an on-device loop body" if s.in_loop
+                         else "a per-token step")
+                out.append(_finding(
+                    "TPU305", rec,
+                    f"{s.kind} ({s.global_bytes} bytes) crosses slow "
+                    f"axis '{axis}' (link=dcn) from {where} — a "
+                    "latency-bound collective on the inter-slice "
+                    "network; restructure to overlap or batch it"))
+    return out
+
+
+#: rule id -> (name, description, checker). TPU300 is the meta-rule
+#: for SHARD_BASELINE drift and unparseable lowered signatures
+#: (reported by core, like tpu-verify's TPU100).
+SHARD_RULES = {
+    "TPU300": ("shard-drift",
+               "per-program per-axis collective byte totals drifted "
+               "from the committed SHARD_BASELINE.json", None),
+    "TPU301": ("undeclared-resharding",
+               "collective crosses an undeclared mesh axis/kind or "
+               "moves more bytes than the per-axis budget allows",
+               check_tpu301),
+    "TPU302": ("replicated-large-buffer",
+               "large buffer lowered replicated where the declared "
+               "layout (pool_pspec/_tp_specs) says sharded",
+               check_tpu302),
+    "TPU303": ("pspec-layout-drift",
+               "declared PartitionSpec plan disagrees with the "
+               "program's lowered in/out sharding", check_tpu303),
+    "TPU304": ("axis-unsafe-collective-shape",
+               "collective payload exceeds the declared axis-size-"
+               "invariant bound (bytes scale with the mesh)",
+               check_tpu304),
+    "TPU305": ("dcn-hostile-collective",
+               "latency-bound (per-token / in-loop) collective "
+               "crosses a declared slow (DCN) axis", check_tpu305),
+}
+
+
+def all_shard_rule_ids():
+    return sorted(SHARD_RULES)
+
+
+def check_record(rec):
+    """Run every TPU3xx rule over one record. Contract waivers mark
+    findings suppressed (same etiquette as the trace tier)."""
+    findings = []
+    for rule_id in all_shard_rule_ids():
+        check = SHARD_RULES[rule_id][2]
+        if check is None:
+            continue
+        found = check(rec)
+        why = rec.contract.waived(rule_id)
+        if why is not None:
+            for f in found:
+                f.suppressed = True
+        findings.extend(found)
+    return findings

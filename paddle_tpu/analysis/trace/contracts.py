@@ -68,6 +68,12 @@ class TraceContract:
         sub-fp32 operands.
     allow_host_callbacks: TPU106 — compiled hot-path steps must never
         re-enter python mid-program.
+    per_token: the program runs once PER GENERATED TOKEN (the decode /
+        verify steps — the host loop body), so every collective in it
+        sits on the per-token latency path. tpu-shard TPU305 flags
+        per-token collectives that cross a budget axis declared "dcn"
+        (slow inter-slice link); prefills and the COW copy run per
+        admission, not per token, and leave this False.
     waive: ((rule_id, justification), ...) — inline, colocated
         suppressions. Empty justifications are rejected at check time,
         same etiquette as the committed baseline.
@@ -80,6 +86,7 @@ class TraceContract:
     max_const_bytes: int = 4096
     accum_dtype: str = "float32"
     allow_host_callbacks: bool = False
+    per_token: bool = False
     waive: tuple = ()
 
     def waived(self, rule_id):
@@ -144,7 +151,15 @@ def resolve_budget(contract):
                 f"budget {contract.collective_budget!r} which does "
                 f"not resolve: {e}") from e
     if budget is not None and not isinstance(budget, CollectiveBudget):
-        raise TypeError(
-            f"contract {contract.name}: collective_budget must be a "
-            f"CollectiveBudget or 'mod:NAME' reference, got {budget!r}")
+        # the per-axis table (jit.introspect.AxisCollectiveBudget)
+        # exposes the same count surface (per_layer/fixed/allowed/
+        # kinds) PLUS the axis/byte view tpu-shard consumes — both
+        # tiers resolve through here so the tables cannot fork
+        from paddle_tpu.jit.introspect import AxisCollectiveBudget
+
+        if not isinstance(budget, AxisCollectiveBudget):
+            raise TypeError(
+                f"contract {contract.name}: collective_budget must be "
+                "a CollectiveBudget, an AxisCollectiveBudget or a "
+                f"'mod:NAME' reference, got {budget!r}")
     return budget

@@ -110,10 +110,14 @@ def _build_model():
     return model
 
 
-def _trace_one(name, config, pure_fn, jitted, args, mp, num_layers):
+def _trace_one(name, config, pure_fn, jitted, args, mp, num_layers,
+               declared=None, geometry=None):
     """make_jaxpr + lower ONE program and capture the TracedProgram
     record the rules consume. `jitted` is the engine's own jit wrapper
-    (its donation and out_shardings, not the checker's)."""
+    (its donation and out_shardings, not the checker's). `declared` is
+    an optional (in_specs, out_specs) pair of per-leaf layout tuples
+    (see `_declared_specs`) and `geometry` the serving-symbol dict —
+    both consumed by the tpu-shard tier."""
     import jax
 
     contract = get_contract(name)
@@ -124,11 +128,61 @@ def _trace_one(name, config, pure_fn, jitted, args, mp, num_layers):
         for i in contract.donate_argnums)
     leaves = [(jax.tree_util.keystr(path), leaf) for path, leaf in
               jax.tree_util.tree_flatten_with_path(args)[0]]
+    d_in, d_out = declared if declared is not None else (None, None)
     return TracedProgram(
         contract=contract, config=config, mp=mp,
         num_layers=num_layers, jaxpr=closed,
         lowered_text=lowered.as_text(), donated_leaves=donated,
-        arg_leaves=leaves)
+        arg_leaves=leaves, declared_in_specs=d_in,
+        declared_out_specs=d_out, geometry=geometry)
+
+
+def _declared_specs(eng, args, kv, lora, n_out_repl):
+    """The engine's DECLARED layout truth for one step, flattened per
+    argument leaf in signature order: `_tp_specs` for the state
+    (quantized entries contribute their (codes, scale) spec pair),
+    `pool_pspec()` for both pool planes, a replicated spec for the
+    int8 scale grid, the adapter pool's `pool_pspecs()`, and None
+    (no declaration) for the trailing host args. Outputs mirror
+    `_step_out_shardings`: `n_out_repl` replicated leading outputs,
+    then the sharded pools, then the replicated scale grid. Specs are
+    converted to pure per-dim axis-name tuples (() = replicated) so
+    the tpu-shard rules never import jax. None/None at mp == 1 —
+    there is no declared mesh layout to drift from."""
+    if eng.mesh is None:
+        return None, None
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    ins = []
+    for spec in eng._tp_specs:
+        pair = (spec,) if isinstance(spec, P) else tuple(spec)
+        ins.extend(tuple(s) for s in pair)
+    pool = tuple(eng.cache.pool_pspec())
+    ins += [pool, pool]
+    if kv:
+        ins.append(())
+    if lora:
+        ins.extend(tuple(s) for s in eng.adapter_pool.pool_pspecs())
+    n_host = len(jax.tree_util.tree_leaves(args)) - len(ins)
+    assert n_host >= 0, "declared specs outnumber the program's leaves"
+    out_specs = ((),) * n_out_repl + (pool, pool) \
+        + (((),) if kv else ())
+    return tuple(ins) + (None,) * n_host, out_specs
+
+
+def _geometry(eng, num_layers, tokens):
+    """The serving-geometry symbols tpu-shard's payload bounds
+    (AxisCollectiveBudget entries) evaluate over — from the engine
+    and model the program was actually traced from."""
+    cfg = eng.model.config
+    return dict(tokens=tokens, hidden=cfg.hidden_size,
+                intermediate=cfg.intermediate_size,
+                vocab=cfg.vocab_size, heads=cfg.num_heads,
+                head_dim=cfg.hidden_size // cfg.num_heads,
+                layers=num_layers, blocks=eng.cache.num_blocks,
+                block_size=eng.cache.block_size,
+                slots=eng.num_slots)
 
 
 def _build_registry(config):
@@ -255,7 +309,10 @@ def harvest(matrix=None):
             step_name = "engine_decode_step"
         programs.append(_trace_one(
             step_name, config, eng._decode_pure, eng._decode,
-            step_args, mp, L))
+            step_args, mp, L,
+            declared=_declared_specs(eng, step_args, kv, lora,
+                                     eng._decode_n_out),
+            geometry=_geometry(eng, L, S * (K + 1))))
         # the prefill programs and the COW copy are backend- and
         # K-invariant today (paged_prefill_chunk has no backend seam;
         # the decode/verify steps are where the backends diverge), so
@@ -269,13 +326,14 @@ def harvest(matrix=None):
             srows1 = samp_rows(1) if samp else ()
             chunk_tokens = jnp.asarray(np.zeros((1, C), np.int32))
             row = jnp.asarray(np.zeros(MB, np.int32))
+            pc_args = (state, kp, vp, *sc, *lp, chunk_tokens,
+                       jnp.int32(0), jnp.int32(TINY["block_size"] + 1),
+                       row, *srows1, *arow1)
             programs.append(_trace_one(
                 "engine_prefill_chunk", f"mp={mp}{tag}",
-                eng._prefill_pure, eng._prefill,
-                (state, kp, vp, *sc, *lp, chunk_tokens, jnp.int32(0),
-                 jnp.int32(TINY["block_size"] + 1), row, *srows1,
-                 *arow1),
-                mp, L))
+                eng._prefill_pure, eng._prefill, pc_args, mp, L,
+                declared=_declared_specs(eng, pc_args, kv, lora, 1),
+                geometry=_geometry(eng, L, C)))
             bucket = TINY["seq"] // 2
             beng = check_knobs(GenerationEngine(
                 model, num_slots=TINY["slots"],
@@ -291,20 +349,33 @@ def harvest(matrix=None):
             bsc = (beng.cache.scales,) if kv else ()
             blp = (beng.adapter_pool.arrays(),) if lora else ()
             brow = jnp.asarray(np.zeros(beng.max_blocks, np.int32))
+            bp_args = (beng._state_arrays(), beng.cache.kpool,
+                       beng.cache.vpool, *bsc, *blp, btok,
+                       jnp.int32(bucket - 2), brow, *srows1, *arow1)
             programs.append(_trace_one(
                 "engine_prefill", f"mp={mp}{tag}", beng._prefill_pure,
-                beng._prefill,
-                (beng._state_arrays(), beng.cache.kpool,
-                 beng.cache.vpool, *bsc, *blp, btok,
-                 jnp.int32(bucket - 2), brow, *srows1, *arow1),
-                mp, L))
+                beng._prefill, bp_args, mp, L,
+                declared=_declared_specs(beng, bp_args, kv, lora, 1),
+                geometry=_geometry(beng, L, bucket)))
             if not lora and not samp:
                 # the COW copy is adapter- AND sampling-oblivious:
                 # both config families skip it (no duplicate entry)
                 cow_args = (kp, vp, jnp.int32(1), jnp.int32(2), *sc)
+                if mp > 1:
+                    # plain jit, not shard_map — but the pools ride
+                    # committed at pool_pspec() and the jit pins its
+                    # out_shardings, so the declared truth is the same
+                    pool = tuple(eng.cache.pool_pspec())
+                    tail = (((),) if kv else ())
+                    cow_declared = ((pool, pool, None, None) + tail,
+                                    (pool, pool) + tail)
+                else:
+                    cow_declared = (None, None)
                 programs.append(_trace_one(
                     "engine_cow_copy", f"mp={mp}{tag}", eng._cow_pure,
-                    eng._cow, cow_args, mp, L))
+                    eng._cow, cow_args, mp, L,
+                    declared=cow_declared,
+                    geometry=_geometry(eng, L, 0)))
     if include_conv:
         programs.extend(_conv_programs())
     return programs

@@ -71,6 +71,20 @@ class TracedProgram:
     lowered_text: str               # StableHLO module text
     donated_leaves: int             # array leaves under donate_argnums
     arg_leaves: list = field(default_factory=list)  # (path, leaf)
+    # declared layout truth, captured by the harvester from the
+    # ENGINE'S OWN spec surfaces (_tp_specs / pool_pspec() /
+    # adapter pool_pspecs()) for the tpu-shard tier (TPU302/TPU303):
+    # per argument leaf (in signature order) a tuple of per-dim mesh
+    # axis names (None = unsharded dim), () = declared replicated,
+    # or None = no declared layout (host args); None for the whole
+    # field at mp == 1 / non-engine programs. Pure data — no jax
+    # objects, so the rules stay import-smoke clean.
+    declared_in_specs: tuple = None
+    declared_out_specs: tuple = None
+    # serving geometry symbols (tokens/hidden/intermediate/vocab/
+    # layers/blocks/block_size/heads/head_dim/slots) the tpu-shard
+    # payload bounds evaluate over; None for non-engine programs
+    geometry: dict = None
 
     @property
     def key(self):

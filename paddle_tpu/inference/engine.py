@@ -3427,5 +3427,11 @@ for _step in ("engine_prefill", "engine_prefill_chunk",
         name=_step,
         declared_at="paddle_tpu/inference/engine.py",
         donate_argnums=introspect.ENGINE_STEP_DONATION[_step],
-        collective_budget=_GPT_SERVING_BUDGET))
+        collective_budget=_GPT_SERVING_BUDGET,
+        # decode/verify are the host loop body — one dispatch per
+        # generated token, so their collectives sit on the per-token
+        # latency path (tpu-shard TPU305 gates these against any
+        # future slow/DCN mesh axis); prefills run per admission
+        per_token=_step in ("engine_decode_step",
+                            "engine_verify_step")))
 del _step

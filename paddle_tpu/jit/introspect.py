@@ -325,3 +325,147 @@ ALLOCATOR_RELEASE_EFFECTS = {
     "PagedKVCache": ("free", "allocate"),
     "PagedAdapterPool": ("release", "acquire"),
 }
+
+# ---------------------------------------------------------------------------
+# Per-axis collective budget (tpu-verify TPU104 / tpu-shard TPU30x)
+# ---------------------------------------------------------------------------
+# The ENGINE_STEP_DONATION precedent applied to mesh collectives: ONE
+# declared table carries, per (mesh axis, collective kind), the
+# allowed per-transformer-layer count, the allowed fixed count, and a
+# payload bound expressed over the serving geometry. tpu-verify's
+# TPU104 consumes the COUNT view (per_layer/fixed/allowed — the same
+# surface the old count-only CollectiveBudget exposed, so the count
+# gate is unchanged by construction); tpu-shard's TPU301/304/305
+# consume the AXIS view (which axis a collective may cross, how many
+# bytes it may move, and whether that axis is a fast ICI link or a
+# slow DCN one). Counts and bytes can never drift apart because they
+# are rows of the same table.
+
+
+class AxisCollectiveBudget:
+    """Per-mesh-axis collective budget of ONE compiled serving step.
+
+    axes: ((axis_name, link), ...) — every mesh axis the step may run
+        collectives over, with its link class: "ici" (fast intra-slice
+        interconnect) or "dcn" (slow inter-slice network; tpu-shard
+        TPU305 flags per-token collectives crossing these).
+    entries: ((axis, kind, per_layer, fixed, payload), ...) — per
+        (axis, collective kind): the allowed per-transformer-layer
+        count, the allowed fixed (embed / lm-head / whole-step) count,
+        and a payload bound in BYTES as an arithmetic expression over
+        the harvest geometry symbols (tokens, hidden, intermediate,
+        vocab, heads, head_dim, layers, blocks, block_size, slots —
+        see analysis.shard.model.eval_payload). The bound is the
+        GLOBAL (post-gather / pre-reduce logical) payload, which is
+        invariant to the axis size — a collective whose bytes scale
+        with the mesh is exactly what TPU304 exists to catch.
+
+    Pure data + arithmetic: no jax import, no framework import.
+    """
+
+    def __init__(self, axes=(), entries=()):
+        self.axes = tuple(tuple(a) for a in axes)
+        self.entries = tuple(tuple(e) for e in entries)
+        links = {"ici", "dcn"}
+        for _, link in self.axes:
+            if link not in links:
+                raise ValueError(
+                    f"axis link must be one of {sorted(links)}, "
+                    f"got {link!r}")
+        names = set(self.axis_names())
+        for axis, kind, per, fix, payload in self.entries:
+            if axis not in names:
+                raise ValueError(
+                    f"budget entry ({axis!r}, {kind!r}) names an axis "
+                    "missing from the axes table")
+
+    def __eq__(self, other):
+        return (isinstance(other, AxisCollectiveBudget)
+                and self.axes == other.axes
+                and self.entries == other.entries)
+
+    def __hash__(self):
+        return hash((self.axes, self.entries))
+
+    def __repr__(self):
+        return (f"AxisCollectiveBudget(axes={self.axes!r}, "
+                f"entries={self.entries!r})")
+
+    # -- count view (the CollectiveBudget surface TPU104 consumes) ----
+    def _merged(self, idx):
+        out = {}
+        for e in self.entries:
+            out[e[1]] = out.get(e[1], 0) + e[idx]
+        return tuple(sorted((k, v) for k, v in out.items() if v))
+
+    @property
+    def per_layer(self):
+        return self._merged(2)
+
+    @property
+    def fixed(self):
+        return self._merged(3)
+
+    def allowed(self, kind, num_layers):
+        per = dict(self.per_layer).get(kind, 0)
+        fix = dict(self.fixed).get(kind, 0)
+        return per * num_layers + fix
+
+    def kinds(self):
+        return sorted(set(dict(self.per_layer))
+                      | set(dict(self.fixed)))
+
+    # -- axis view (tpu-shard TPU301/304/305) -------------------------
+    def axis_names(self):
+        return tuple(a for a, _ in self.axes)
+
+    def link_of(self, axis):
+        return dict(self.axes).get(axis)
+
+    def slow_axes(self):
+        return tuple(a for a, link in self.axes if link == "dcn")
+
+    def entries_for(self, axis):
+        return tuple(e for e in self.entries if e[0] == axis)
+
+    def allowed_on_axis(self, axis, kind, num_layers):
+        n = 0
+        for _, k, per, fix, _ in self.entries_for(axis):
+            if k == kind:
+                n += per * num_layers + fix
+        return n
+
+    def payload_bounds(self, axis, kind):
+        """Payload-bound expressions for (axis, kind), one per entry
+        row — () when the kind is undeclared on that axis."""
+        return tuple(e[4] for e in self.entries_for(axis)
+                     if e[1] == kind)
+
+
+#: Per-axis collective budget of ONE tensor-parallel GPT serving step
+#: (the table `models/gpt.py:GPT_SERVING_COLLECTIVES` aliases — the
+#: helpers there are the only places serving collectives come from).
+#: Per transformer layer over the 'mp' (ICI) axis: _attn_out
+#: all-gathers twice (head reassembly + out_proj columns) and the MLP
+#: twice (fc1 + fc2 columns) = 4, each bounded by the widest gathered
+#: activation (the fc1 intermediate rows); plus AT MOST one pmax when
+#: the int8 KV cache is on (the quant-on-write grid fold in
+#: ops/paged_attention — per-block scales are global across the
+#: head-sharded pools, so the shards' absmax must agree; fp steps emit
+#: zero pmax and TPU100's exact op snapshot pins that), bounded by the
+#: full fp32 scale grid. Fixed: one lm-head logits all-gather
+#: (tokens x vocab), one vocab-parallel-embedding psum
+#: (tokens x hidden), and one pmax for the bucketed prefill's
+#: whole-prompt quantized write (all layers folded in a single
+#: scatter). An accidental fifth per-layer gather (or a brand-new
+#: collective kind, or an axis-size-scaling payload) fails the trace
+#: gates instead of stretching every decode step.
+GPT_SERVING_AXIS_BUDGET = AxisCollectiveBudget(
+    axes=(("mp", "ici"),),
+    entries=(
+        ("mp", "all_gather", 4, 0, "tokens * intermediate * 4"),
+        ("mp", "all_gather", 0, 1, "tokens * vocab * 4"),
+        ("mp", "psum", 0, 1, "tokens * hidden * 4"),
+        ("mp", "pmax", 1, 1, "layers * blocks * 2 * 4"),
+    ),
+)

@@ -21,7 +21,7 @@ import numpy as np
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
-from paddle_tpu.analysis.trace.contracts import CollectiveBudget
+from paddle_tpu.jit import introspect
 from paddle_tpu.ops import manipulation as mp
 
 
@@ -34,24 +34,16 @@ def _mp_degree():
         return 1
 
 
-# Collective budget of ONE tensor-parallel serving step of this model
-# (tpu-verify TPU104; declared here because the helpers right below
-# are the only places serving collectives come from). Per transformer
-# layer: _attn_out all-gathers twice (head reassembly + out_proj
-# columns) and the MLP twice (fc1 + fc2 columns) = 4, plus AT MOST one
-# pmax when the int8 KV cache is on (the quant-on-write grid fold in
-# ops/paged_attention — per-block scales are global across the
-# head-sharded pools, so the shards' absmax must agree; fp steps emit
-# zero pmax and TPU100's exact op snapshot pins that). Fixed: one
-# lm-head logits all-gather + one vocab-parallel-embedding psum, plus
-# one pmax for the bucketed prefill's whole-prompt quantized write
-# (all layers folded in a single scatter). An accidental fifth
-# per-layer gather (or a brand-new collective kind) fails the trace
-# gate instead of stretching every decode step.
-GPT_SERVING_COLLECTIVES = CollectiveBudget(
-    per_layer=(("all_gather", 4), ("pmax", 1)),
-    fixed=(("all_gather", 1), ("psum", 1), ("pmax", 1)),
-)
+# Collective budget of ONE tensor-parallel serving step of this model.
+# The numbers live in `jit.introspect.GPT_SERVING_AXIS_BUDGET` — ONE
+# per-(mesh axis, kind) table carrying counts AND payload-byte bounds,
+# consumed by tpu-verify TPU104 (counts) and tpu-shard TPU301/304/305
+# (axes + bytes) — and this module keeps the canonical alias because
+# the helpers right below (_mp_all_gather / _vocab_parallel_embed) are
+# the only places serving collectives come from. The engine's step
+# contracts reference it lazily as
+# "paddle_tpu.models.gpt:GPT_SERVING_COLLECTIVES".
+GPT_SERVING_COLLECTIVES = introspect.GPT_SERVING_AXIS_BUDGET
 
 
 def _mp_all_gather(t, mp_axis):

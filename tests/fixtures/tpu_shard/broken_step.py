@@ -1,0 +1,4 @@
+# tpu-shard positive-fixture anchor: the tests' FIRING fixtures
+# declare this file as their `declared_at`; findings must land at
+# broken_step.py:1 (this line). No suppression comments here either —
+# the findings must stay live.

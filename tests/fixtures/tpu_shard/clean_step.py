@@ -1,0 +1,5 @@
+# tpu-shard negative-fixture anchor: contracts in
+# tests/test_tpu_shard.py declare this file as their `declared_at`, so
+# every finding a rule would emit anchors HERE at line 1 — the tests
+# assert the exact file:line. This file intentionally carries no
+# suppression comments.

@@ -1,0 +1,6 @@
+# tpu-lint: disable=TPU301
+# tpu-race: disable=TPU301
+# Fixture: SIBLING tiers' tags on the anchor line's file — line 1
+# carries a tpu-lint disable for the very rule id the test fires, and
+# it must NOT suppress a tpu-shard finding (tag namespaces are
+# disjoint in both directions).

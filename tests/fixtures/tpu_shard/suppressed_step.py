@@ -1,0 +1,3 @@
+# tpu-shard: disable=TPU301 -- fixture: proves the same-line tag
+# (line 1 is the anchor line for every tpu-shard finding on this
+# file; the disable above must suppress TPU301 and ONLY TPU301).

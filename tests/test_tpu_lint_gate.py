@@ -39,8 +39,9 @@ def test_repo_is_lint_clean_against_baseline(repo_analysis):
         f.render() for f in new)
     assert res.parse_errors == []
     # the repo gate must actually cover the codebase, not an empty
-    # glob (PR 19 added the analysis/race tier: 187 files and counting)
-    assert len(res.files) > 185
+    # glob (PR 20 added the analysis/shard tier: 196 files and
+    # counting)
+    assert len(res.files) > 190
 
 
 def test_baseline_is_small_and_justified(repo_analysis):

@@ -70,22 +70,27 @@ def test_tpu203_fires_on_broken_depth2_pipe_and_passes_fixed():
 
 
 def test_rule_id_namespaces_are_disjoint():
-    """One registry test over all three analysis tiers: tpu-lint
-    TPU0xx, tpu-verify TPU1xx, tpu-race TPU2xx — no id collisions,
-    each tier inside its own hundred-block."""
+    """One registry test over all four analysis tiers: tpu-lint
+    TPU0xx, tpu-verify TPU1xx, tpu-race TPU2xx, tpu-shard TPU3xx — no
+    id collisions, each tier inside its own hundred-block."""
     from paddle_tpu.analysis import all_rule_ids
     from paddle_tpu.analysis.race.rules import all_race_rule_ids
+    from paddle_tpu.analysis.shard.rules import all_shard_rule_ids
     from paddle_tpu.analysis.trace.rules import all_trace_rule_ids
 
-    lint = set(all_rule_ids())
-    trace = set(all_trace_rule_ids())
-    race = set(all_race_rule_ids())
-    assert lint and trace and race
-    assert not (lint & trace) and not (lint & race) \
-        and not (trace & race)
-    assert all(0 <= int(r[3:]) <= 99 for r in lint)
-    assert all(100 <= int(r[3:]) <= 199 for r in trace)
-    assert all(200 <= int(r[3:]) <= 299 for r in race)
+    tiers = {
+        "lint": (set(all_rule_ids()), 0),
+        "trace": (set(all_trace_rule_ids()), 100),
+        "race": (set(all_race_rule_ids()), 200),
+        "shard": (set(all_shard_rule_ids()), 300),
+    }
+    for name, (ids, base) in tiers.items():
+        assert ids, name
+        assert all(base <= int(r[3:]) <= base + 99 for r in ids), name
+    names = sorted(tiers)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            assert not (tiers[a][0] & tiers[b][0]), (a, b)
 
 
 def test_introspect_effect_tables_name_real_methods():

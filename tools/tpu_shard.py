@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""tpu-shard — static sharding-layout & per-axis collective-byte
+analyzer.
+
+Consumes the tpu-verify harvest (every registered compiled program,
+abstractly lowered on CPU over the full serving matrix) and enforces
+the TPU3xx sharding contracts: every collective classified by mesh
+axis and byte-budgeted against `jit.introspect.GPT_SERVING_AXIS_BUDGET`
+(TPU301/TPU304/TPU305), every declared PartitionSpec checked against
+the lowered module's actual shardings (TPU302/TPU303), and per-axis
+byte totals drift-pinned in the committed SHARD_BASELINE.json
+(TPU300).
+
+Usage:
+    python tools/tpu_shard.py paddle_tpu/
+    python tools/tpu_shard.py --stats --format=json
+    python tools/tpu_shard.py --list-rules
+    python tools/tpu_shard.py --write-shard-baseline
+
+See README "Sharding analysis" for the rule table and budget
+etiquette. Runs as a tier-1 gate (tests/test_tpu_shard_gate.py).
+"""
+import os
+import sys
+
+# abstract tracing on CPU is sufficient (DESIGN_DECISIONS r13) and the
+# mp=2 configs need a virtual device mesh — both must be pinned BEFORE
+# the first jax backend init
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.analysis.shard.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
